@@ -1,0 +1,10 @@
+"""noahgameframe_tpu — a TPU-native distributed entity framework.
+
+A ground-up rebuild of the capabilities of NoahGameFrame (plugin/module
+kernel, schema-driven entities, events/heartbeats, scene/group AOI
+broadcast, five-role server topology, persistence) designed TPU-first: the
+world is a Structure-of-Arrays pytree on device and the frame tick is one
+jit-compiled JAX function, sharded over a device mesh with shard_map.
+"""
+
+__version__ = "0.1.0"

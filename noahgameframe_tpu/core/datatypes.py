@@ -1,0 +1,181 @@
+"""Typed value model for the entity framework.
+
+The reference framework's universal value type is a tagged variant over
+{int, float, string, object(GUID), vector2, vector3} (see reference
+NFComm/NFCore/NFIDataList.h:37-47 for the enum and :67-150 for the variant).
+On TPU we cannot store variants: every property is compiled to a column in a
+dtype-homogeneous bank (see `schema.py`).  This module defines the type enum,
+its device representation, and the host-side value coercions.
+
+Device representation choices (TPU-first):
+  INT     -> int32 column           (i32 bank)
+  FLOAT   -> float32 column         (f32 bank)
+  STRING  -> int32 interned handle  (i32 bank; see strings.StringTable)
+  OBJECT  -> int32 entity handle    (i32 bank; row-handle into the world,
+                                     -1 == null; host maps handle<->Guid)
+  VECTOR2 -> float32[3] (z unused)  (vec bank, unified with VECTOR3 so both
+                                     live in one [cap, nvec, 3] array)
+  VECTOR3 -> float32[3]             (vec bank)
+
+128-bit GUIDs never live on device: entities are addressed by dense row
+index, and the host keeps a Guid<->(class,row) map (reference generates
+GUIDs as {app_id, time*1e6+counter}, NFCKernelModule.cpp:955-979 — ours are
+the same shape, host-side only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time as _time
+from typing import Any, Tuple, Union
+
+import numpy as np
+
+
+class DataType(enum.IntEnum):
+    """Mirrors the reference TDATA_TYPE enum (NFIDataList.h:37-47)."""
+
+    UNKNOWN = 0
+    INT = 1
+    FLOAT = 2
+    STRING = 3
+    OBJECT = 4
+    VECTOR2 = 5
+    VECTOR3 = 6
+
+
+# XML `Type=` attribute spelling -> DataType (NFCClassModule::ComputerType,
+# reference NFCClassModule.cpp:45-70 accepts these same spellings).
+XML_TYPE_NAMES = {
+    "int": DataType.INT,
+    "float": DataType.FLOAT,
+    "double": DataType.FLOAT,
+    "string": DataType.STRING,
+    "object": DataType.OBJECT,
+    "vector2": DataType.VECTOR2,
+    "vector3": DataType.VECTOR3,
+}
+
+# Which bank each logical type is compiled into.
+class Bank(enum.Enum):
+    I32 = "i32"
+    F32 = "f32"
+    VEC = "vec"  # float32[..., 3]
+
+
+BANK_OF_TYPE = {
+    DataType.INT: Bank.I32,
+    DataType.STRING: Bank.I32,
+    DataType.OBJECT: Bank.I32,
+    DataType.FLOAT: Bank.F32,
+    DataType.VECTOR2: Bank.VEC,
+    DataType.VECTOR3: Bank.VEC,
+}
+
+NULL_OBJECT = -1  # device encoding of the null GUID
+NULL_STRING = 0  # StringTable interns "" as handle 0
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Guid:
+    """128-bit entity identity: (head, data) like the reference NFGUID
+    (NFGUID.h:17-45). Host-side only; never shipped to device."""
+
+    head: int = 0
+    data: int = 0
+
+    def is_null(self) -> bool:
+        return self.head == 0 and self.data == 0
+
+    def __str__(self) -> str:  # matches "head-data" human form
+        return f"{self.head}-{self.data}"
+
+    @staticmethod
+    def parse(s: str) -> "Guid":
+        if not s:
+            return Guid()
+        head, _, data = s.partition("-")
+        return Guid(int(head), int(data or 0))
+
+
+NULL_GUID = Guid()
+
+
+class GuidAllocator:
+    """Monotonic GUID source: {app_id, epoch_micros + counter} like the
+    reference kernel's CreateGUID (NFCKernelModule.cpp:955-979), but
+    thread-safe."""
+
+    def __init__(self, app_id: int = 1):
+        self._app_id = int(app_id)
+        self._lock = threading.Lock()
+        self._last = 0
+
+    def next(self) -> Guid:
+        with self._lock:
+            now = int(_time.time() * 1_000_000)
+            if now <= self._last:
+                now = self._last + 1
+            self._last = now
+            return Guid(self._app_id, now)
+
+
+Vector2 = Tuple[float, float]
+Vector3 = Tuple[float, float, float]
+Value = Union[int, float, str, Guid, Vector2, Vector3]
+
+
+def default_value(t: DataType) -> Value:
+    if t == DataType.INT:
+        return 0
+    if t == DataType.FLOAT:
+        return 0.0
+    if t == DataType.STRING:
+        return ""
+    if t == DataType.OBJECT:
+        return NULL_GUID
+    if t == DataType.VECTOR2:
+        return (0.0, 0.0)
+    if t == DataType.VECTOR3:
+        return (0.0, 0.0, 0.0)
+    raise ValueError(f"no default for {t}")
+
+
+def coerce(t: DataType, v: Any) -> Value:
+    """Coerce a python value (e.g. an XML attribute string) to type `t`."""
+    if t == DataType.INT:
+        if isinstance(v, str):
+            return int(float(v)) if v.strip() else 0
+        return int(v)
+    if t == DataType.FLOAT:
+        if isinstance(v, str):
+            return float(v) if v.strip() else 0.0
+        return float(v)
+    if t == DataType.STRING:
+        return str(v)
+    if t == DataType.OBJECT:
+        if isinstance(v, Guid):
+            return v
+        if isinstance(v, str):
+            # instance XMLs write object fields as "0" / "" / "head-data"
+            if not v.strip() or v.strip() == "0":
+                return NULL_GUID
+            return Guid.parse(v)
+        if isinstance(v, int):
+            return Guid(0, v)
+        raise TypeError(f"cannot coerce {v!r} to OBJECT")
+    if t in (DataType.VECTOR2, DataType.VECTOR3):
+        n = 2 if t == DataType.VECTOR2 else 3
+        if isinstance(v, str):
+            parts = [p for p in v.replace(",", " ").split() if p]
+            vals = [float(p) for p in parts] + [0.0] * n
+            return tuple(vals[:n])
+        vals = [float(x) for x in v]
+        return tuple((vals + [0.0] * n)[:n])
+    raise ValueError(f"cannot coerce to {t}")
+
+
+def np_dtype(bank: Bank) -> np.dtype:
+    return np.dtype(np.int32) if bank == Bank.I32 else np.dtype(np.float32)

@@ -1,0 +1,162 @@
+"""Element (per-instance config) store.
+
+The reference loads each class's InstancePath XML — rows of
+`<Object Id="Elem" Prop="value" .../>` — into a string-keyed config map with
+typed getters (NFCElementModule.cpp:43-76).  We keep that host API and add
+the TPU-side view: `table()` compiles a set of element rows into dense
+config arrays + an id->index map so jitted code can gather per-entity config
+by an int32 `config_idx` column.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import xml.etree.ElementTree as ET
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .datatypes import Bank, DataType, Value, coerce, default_value
+from .schema import ClassRegistry, ClassSpec
+from .strings import StringTable
+
+
+@dataclasses.dataclass
+class Element:
+    id: str
+    class_name: str
+    values: Dict[str, Value]
+
+
+@dataclasses.dataclass
+class ElementTable:
+    """Dense config-by-index arrays for one class, for device gathers."""
+
+    class_name: str
+    ids: List[str]
+    index: Dict[str, int]  # element id -> row
+    i32: np.ndarray  # [n_elems, n_i32]
+    f32: np.ndarray  # [n_elems, n_f32]
+    vec: np.ndarray  # [n_elems, n_vec, 3]
+
+
+class ElementStore:
+    def __init__(self, registry: ClassRegistry, strings: Optional[StringTable] = None):
+        self.registry = registry
+        self.strings = strings or StringTable()
+        self._elements: Dict[str, Element] = {}
+        self._by_class: Dict[str, List[str]] = {}
+        self._tables: Dict[str, ElementTable] = {}
+
+    # -- loading ------------------------------------------------------------
+
+    def add_element(self, class_name: str, elem_id: str, values: Dict[str, Value]) -> Element:
+        if elem_id in self._elements:
+            raise ValueError(f"element {elem_id!r} already defined")
+        spec = self.registry.spec(class_name)
+        coerced: Dict[str, Value] = {}
+        for k, v in values.items():
+            if spec.has_property(k):
+                coerced[k] = coerce(spec.slot(k).prop.type, v)
+            # unknown attributes are ignored, as the reference does
+        e = Element(elem_id, class_name, coerced)
+        self._elements[elem_id] = e
+        self._by_class.setdefault(class_name, []).append(elem_id)
+        self._tables.pop(class_name, None)
+        return e
+
+    def load_instance_xml(self, class_name: str, path: Path) -> int:
+        """Load one reference-format Ini XML for class_name; returns count."""
+        root = ET.parse(str(path)).getroot()
+        n = 0
+        for obj in root.findall("Object"):
+            attrs = dict(obj.attrib)
+            elem_id = attrs.pop("Id", None)
+            if not elem_id:
+                continue
+            self.add_element(class_name, elem_id, attrs)
+            n += 1
+        return n
+
+    def load_all(self, data_root: Path) -> int:
+        """Load every class's InstancePath under data_root (reference layout:
+        data_root/NFDataCfg/Ini/NPC/<Class>.xml)."""
+        total = 0
+        for name in self.registry.names():
+            inst = self.registry.get_def(name).instance_path
+            if not inst:
+                continue
+            p = Path(data_root) / inst
+            if p.exists():
+                total += self.load_instance_xml(name, p)
+        return total
+
+    # -- host getters (reference NFIElementModule API) ----------------------
+
+    def exists(self, elem_id: str) -> bool:
+        return elem_id in self._elements
+
+    def element(self, elem_id: str) -> Element:
+        return self._elements[elem_id]
+
+    def ids_of_class(self, class_name: str) -> List[str]:
+        return list(self._by_class.get(class_name, ()))
+
+    def _get(self, elem_id: str, prop: str, t: DataType) -> Value:
+        e = self._elements.get(elem_id)
+        if e is None:
+            return default_value(t)
+        v = e.values.get(prop)
+        return coerce(t, v) if v is not None else default_value(t)
+
+    def get_int(self, elem_id: str, prop: str) -> int:
+        return self._get(elem_id, prop, DataType.INT)  # type: ignore[return-value]
+
+    def get_float(self, elem_id: str, prop: str) -> float:
+        return self._get(elem_id, prop, DataType.FLOAT)  # type: ignore[return-value]
+
+    def get_string(self, elem_id: str, prop: str) -> str:
+        return self._get(elem_id, prop, DataType.STRING)  # type: ignore[return-value]
+
+    # -- device view --------------------------------------------------------
+
+    def table(self, class_name: str) -> ElementTable:
+        """Compile (and cache) the class's elements into dense arrays laid
+        out by the class's bank layout, for `config_idx` gathers in jit."""
+        tab = self._tables.get(class_name)
+        if tab is not None:
+            return tab
+        spec = self.registry.spec(class_name)
+        ids = self.ids_of_class(class_name)
+        n = len(ids)
+        i32 = np.zeros((n, spec.n_i32), np.int32)
+        f32 = np.zeros((n, spec.n_f32), np.float32)
+        vec = np.zeros((n, spec.n_vec, 3), np.float32)
+        for r, eid in enumerate(ids):
+            e = self._elements[eid]
+            for slot in spec.slots.values():
+                v = e.values.get(slot.prop.name, slot.prop.resolved_default())
+                t = slot.prop.type
+                if slot.bank == Bank.I32:
+                    if t == DataType.STRING:
+                        i32[r, slot.col] = self.strings.intern(str(v))
+                    elif t == DataType.OBJECT:
+                        i32[r, slot.col] = -1
+                    else:
+                        i32[r, slot.col] = int(v)
+                elif slot.bank == Bank.F32:
+                    f32[r, slot.col] = float(v)
+                else:
+                    vv = coerce(t, v)
+                    vec[r, slot.col, : len(vv)] = vv
+        tab = ElementTable(
+            class_name=class_name,
+            ids=ids,
+            index={eid: r for r, eid in enumerate(ids)},
+            i32=i32,
+            f32=f32,
+            vec=vec,
+        )
+        self._tables[class_name] = tab
+        return tab

@@ -1,0 +1,616 @@
+"""Structure-of-Arrays entity store: the device-resident world.
+
+The reference keeps a GUID->object map of heap objects, each owning
+name->property and name->record maps of tagged variants
+(NFCKernelModule.h:30-33, NFCObject.h:19-108).  That layout is hostile to a
+TPU, so here the *entire world is a pytree of dense arrays*:
+
+    WorldState
+      .classes: {class_name: ClassState}
+      .tick:    int32 scalar   (frame counter; time = tick * dt on host)
+      .rng:     PRNG key
+
+    ClassState                       (capacity C, from StoreConfig)
+      .i32:   int32  [C, n_i32]      int / interned-string / object-handle
+      .f32:   float32[C, n_f32]      float properties
+      .vec:   float32[C, n_vec, 3]   vector2/3 properties
+      .alive: bool   [C]             row in use (a live entity)
+      .timers: TimerState [C, n_timers]   (see kernel/schedule.py)
+      .records: {record_name: RecordState}
+
+    RecordState                      (R = max_rows per entity)
+      .i32:  int32  [C, R, n_i32]
+      .f32:  float32[C, R, n_f32]
+      .vec:  float32[C, R, n_vec, 3]
+      .used: bool   [C, R]
+
+Row allocation is host-owned (free-list per class, like the reference's
+deferred create/destroy lists, NFCKernelModule.cpp:76-84): device code only
+ever *clears* `alive` (deaths inside a tick); the host reconciles via
+`EntityStore.reconcile_deaths`.  GUIDs stay host-side in a Guid<->handle
+map; object-valued properties store packed int32 handles
+(class_index << 24 | row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from .datatypes import (
+    Bank,
+    DataType,
+    Guid,
+    GuidAllocator,
+    NULL_OBJECT,
+    Value,
+    coerce,
+    default_value,
+)
+from .schema import ClassRegistry, ClassSpec, RecordSpec
+from .strings import StringTable
+
+HANDLE_ROW_BITS = 24
+HANDLE_ROW_MASK = (1 << HANDLE_ROW_BITS) - 1
+
+
+def pack_handle(class_idx: int, row: int) -> int:
+    return (class_idx << HANDLE_ROW_BITS) | row
+
+
+def unpack_handle(handle: int) -> Tuple[int, int]:
+    return handle >> HANDLE_ROW_BITS, handle & HANDLE_ROW_MASK
+
+
+@struct.dataclass
+class TimerState:
+    """Vectorised heartbeats (reference NFCScheduleModule walks per-object
+    timer maps each tick, NFCScheduleModule.cpp:49-110; here firing is one
+    compare over [C, n_timers])."""
+
+    next_fire: jnp.ndarray  # int32 [C, T] tick index of next firing
+    interval: jnp.ndarray  # int32 [C, T] ticks between firings
+    remain: jnp.ndarray  # int32 [C, T] remaining count, -1 = forever
+    active: jnp.ndarray  # bool  [C, T]
+
+
+@struct.dataclass
+class RecordState:
+    i32: jnp.ndarray
+    f32: jnp.ndarray
+    vec: jnp.ndarray
+    used: jnp.ndarray
+
+
+@struct.dataclass
+class ClassState:
+    i32: jnp.ndarray
+    f32: jnp.ndarray
+    vec: jnp.ndarray
+    alive: jnp.ndarray
+    timers: TimerState
+    records: Dict[str, RecordState]
+
+    @property
+    def capacity(self) -> int:
+        return self.alive.shape[0]
+
+
+@struct.dataclass
+class WorldState:
+    classes: Dict[str, ClassState]
+    tick: jnp.ndarray  # int32 scalar
+    rng: jnp.ndarray  # PRNG key
+
+
+@dataclasses.dataclass
+class StoreConfig:
+    default_capacity: int = 1024
+    capacities: Dict[str, int] = dataclasses.field(default_factory=dict)
+    timer_slots: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def capacity_of(self, class_name: str) -> int:
+        return int(self.capacities.get(class_name, self.default_capacity))
+
+
+def _zeros_class_state(spec: ClassSpec, cap: int, n_timers: int) -> ClassState:
+    recs = {}
+    for rname in spec.record_order:
+        rs: RecordSpec = spec.records[rname]
+        recs[rname] = RecordState(
+            i32=jnp.zeros((cap, rs.max_rows, rs.n_i32), jnp.int32),
+            f32=jnp.zeros((cap, rs.max_rows, rs.n_f32), jnp.float32),
+            vec=jnp.zeros((cap, rs.max_rows, rs.n_vec, 3), jnp.float32),
+            used=jnp.zeros((cap, rs.max_rows), bool),
+        )
+    return ClassState(
+        i32=jnp.zeros((cap, spec.n_i32), jnp.int32),
+        f32=jnp.zeros((cap, spec.n_f32), jnp.float32),
+        vec=jnp.zeros((cap, spec.n_vec, 3), jnp.float32),
+        alive=jnp.zeros((cap,), bool),
+        timers=TimerState(
+            next_fire=jnp.zeros((cap, n_timers), jnp.int32),
+            interval=jnp.ones((cap, n_timers), jnp.int32),
+            remain=jnp.zeros((cap, n_timers), jnp.int32),
+            active=jnp.zeros((cap, n_timers), bool),
+        ),
+        records=recs,
+    )
+
+
+class _ClassHost:
+    """Host bookkeeping for one class: free rows + row->guid."""
+
+    def __init__(self, spec: ClassSpec, class_idx: int, capacity: int):
+        self.spec = spec
+        self.class_idx = class_idx
+        self.capacity = capacity
+        self.free: List[int] = list(range(capacity - 1, -1, -1))
+        self.row_guid: List[Optional[Guid]] = [None] * capacity
+        self.live_count = 0
+
+    def alloc(self) -> int:
+        if not self.free:
+            raise RuntimeError(
+                f"class {self.spec.name!r} capacity {self.capacity} exhausted"
+            )
+        self.live_count += 1
+        return self.free.pop()
+
+    def release(self, row: int) -> None:
+        self.row_guid[row] = None
+        self.free.append(row)
+        self.live_count -= 1
+
+
+class EntityStore:
+    """Host-side owner of the device world: allocation, identity, typed
+    access.  All state mutation is functional — methods take and return
+    WorldState."""
+
+    def __init__(
+        self,
+        registry: ClassRegistry,
+        config: Optional[StoreConfig] = None,
+        strings: Optional[StringTable] = None,
+        guid_alloc: Optional[GuidAllocator] = None,
+        class_names: Optional[Sequence[str]] = None,
+    ):
+        self.registry = registry
+        self.config = config or StoreConfig()
+        self.strings = strings or StringTable()
+        self.guids = guid_alloc or GuidAllocator()
+        names = list(class_names) if class_names is not None else registry.names()
+        self.class_order: List[str] = names
+        self.class_index: Dict[str, int] = {n: i for i, n in enumerate(names)}
+        self._hosts: Dict[str, _ClassHost] = {}
+        self.guid_map: Dict[Guid, int] = {}  # guid -> packed handle
+        for n in names:
+            spec = registry.spec(n)
+            self._hosts[n] = _ClassHost(
+                spec, self.class_index[n], self.config.capacity_of(n)
+            )
+
+    # -- construction -------------------------------------------------------
+
+    def init_state(self, seed: int = 0) -> WorldState:
+        classes = {}
+        for n in self.class_order:
+            h = self._hosts[n]
+            n_timers = int(self.config.timer_slots.get(n, 0))
+            classes[n] = _zeros_class_state(h.spec, h.capacity, n_timers)
+        return WorldState(
+            classes=classes,
+            tick=jnp.zeros((), jnp.int32),
+            rng=jax.random.PRNGKey(seed),
+        )
+
+    def spec(self, class_name: str) -> ClassSpec:
+        return self._hosts[class_name].spec
+
+    def capacity(self, class_name: str) -> int:
+        return self._hosts[class_name].capacity
+
+    def live_count(self, class_name: str) -> int:
+        return self._hosts[class_name].live_count
+
+    # -- value encoding -----------------------------------------------------
+
+    def encode(self, t: DataType, v: Value):
+        """Host value -> device scalar/vector for a property of type t."""
+        if t != DataType.OBJECT:
+            v = coerce(t, v)
+        if t == DataType.INT:
+            return np.int32(v)
+        if t == DataType.FLOAT:
+            return np.float32(v)
+        if t == DataType.STRING:
+            return np.int32(self.strings.intern(v))
+        if t == DataType.OBJECT:
+            if isinstance(v, (int, np.integer)) and not isinstance(v, bool):
+                return np.int32(v)  # raw packed handle passed straight through
+            v = coerce(t, v)
+            if v.is_null():
+                return np.int32(NULL_OBJECT)
+            h = self.guid_map.get(v)
+            if h is None:
+                raise KeyError(f"unknown guid {v} for OBJECT property")
+            return np.int32(h)
+        if t == DataType.VECTOR2:
+            return np.asarray([v[0], v[1], 0.0], np.float32)
+        if t == DataType.VECTOR3:
+            return np.asarray(v, np.float32)
+        raise ValueError(f"cannot encode {t}")
+
+    def decode(self, t: DataType, raw) -> Value:
+        """Device scalar/vector -> host value."""
+        if t == DataType.INT:
+            return int(raw)
+        if t == DataType.FLOAT:
+            return float(raw)
+        if t == DataType.STRING:
+            return self.strings.lookup(int(raw))
+        if t == DataType.OBJECT:
+            h = int(raw)
+            if h == NULL_OBJECT:
+                return Guid()
+            ci, row = unpack_handle(h)
+            g = self._hosts[self.class_order[ci]].row_guid[row]
+            return g if g is not None else Guid()
+        if t == DataType.VECTOR2:
+            a = np.asarray(raw)
+            return (float(a[0]), float(a[1]))
+        if t == DataType.VECTOR3:
+            a = np.asarray(raw)
+            return (float(a[0]), float(a[1]), float(a[2]))
+        raise ValueError(f"cannot decode {t}")
+
+    # -- create / destroy ---------------------------------------------------
+
+    def handle_of(self, guid: Guid) -> int:
+        return self.guid_map[guid]
+
+    def guid_of_handle(self, handle: int) -> Optional[Guid]:
+        h = int(handle)
+        if h < 0:  # NULL_OBJECT and any other negative sentinel
+            return None
+        ci, row = unpack_handle(h)
+        if ci >= len(self.class_order):
+            return None
+        return self._hosts[self.class_order[ci]].row_guid[row]
+
+    def row_of(self, guid: Guid) -> Tuple[str, int]:
+        ci, row = unpack_handle(self.guid_map[guid])
+        return self.class_order[ci], row
+
+    def create_object(
+        self,
+        state: WorldState,
+        class_name: str,
+        guid: Optional[Guid] = None,
+        values: Optional[Dict[str, Value]] = None,
+    ) -> Tuple[WorldState, Guid, int]:
+        """Allocate one row; returns (state', guid, row).  Defaults and
+        overrides are applied column-wise.  The create-event chain
+        (COE_CREATE_* states, reference NFCKernelModule.cpp:251-267) is
+        driven by the kernel module on top of this primitive."""
+        state, guids, rows = self.create_many(
+            state,
+            class_name,
+            1,
+            guids=[guid] if guid is not None else None,
+            values={k: [v] for k, v in (values or {}).items()},
+        )
+        return state, guids[0], rows[0]
+
+    def create_many(
+        self,
+        state: WorldState,
+        class_name: str,
+        n: int,
+        guids: Optional[Sequence[Guid]] = None,
+        values: Optional[Dict[str, Sequence[Value]]] = None,
+    ) -> Tuple[WorldState, List[Guid], np.ndarray]:
+        """Bulk allocate n rows of class_name with per-property value
+        columns.  One scatter per touched bank — this is the fast path used
+        by NPC seeding and the benchmarks."""
+        host = self._hosts[class_name]
+        spec = host.spec
+        # validate identities BEFORE allocating so a failure leaks nothing
+        if guids is not None:
+            if len(guids) != n:
+                raise ValueError("guids length must equal n")
+            if len({*guids}) != n:
+                raise ValueError("duplicate guids in create_many batch")
+            for g in guids:
+                if g in self.guid_map:
+                    raise ValueError(f"guid {g} already exists")
+        if len(host.free) < n:
+            raise RuntimeError(
+                f"class {spec.name!r} capacity {host.capacity} exhausted "
+                f"({len(host.free)} free, {n} requested)"
+            )
+        rows = np.asarray([host.alloc() for _ in range(n)], np.int32)
+        out_guids: List[Guid] = []
+        for i in range(n):
+            g = guids[i] if guids is not None else self.guids.next()
+            self.guid_map[g] = pack_handle(host.class_idx, int(rows[i]))
+            host.row_guid[int(rows[i])] = g
+            out_guids.append(g)
+
+        # column payloads: defaults then overrides
+        i32 = np.zeros((n, spec.n_i32), np.int32)
+        f32 = np.zeros((n, spec.n_f32), np.float32)
+        vec = np.zeros((n, spec.n_vec, 3), np.float32)
+        for slot in spec.slots.values():
+            d = slot.prop.resolved_default()
+            enc = self.encode(slot.prop.type, d)
+            if slot.bank == Bank.I32:
+                i32[:, slot.col] = enc
+            elif slot.bank == Bank.F32:
+                f32[:, slot.col] = enc
+            else:
+                vec[:, slot.col] = enc
+        if values:
+            for pname, col_vals in values.items():
+                slot = spec.slot(pname)
+                enc = [self.encode(slot.prop.type, v) for v in col_vals]
+                if slot.bank == Bank.I32:
+                    i32[:, slot.col] = np.asarray(enc, np.int32)
+                elif slot.bank == Bank.F32:
+                    f32[:, slot.col] = np.asarray(enc, np.float32)
+                else:
+                    vec[:, slot.col] = np.asarray(enc, np.float32)
+
+        cs = state.classes[class_name]
+        # fully reset the rows: banks to defaults/overrides, timers off, and
+        # every record cleared — recycled rows must not leak the previous
+        # entity's records or heartbeat schedule.
+        t = cs.timers
+        timers = TimerState(
+            next_fire=t.next_fire.at[rows].set(0),
+            interval=t.interval.at[rows].set(1),
+            remain=t.remain.at[rows].set(0),
+            active=t.active.at[rows].set(False),
+        )
+        records = {}
+        for rname, rec in cs.records.items():
+            records[rname] = RecordState(
+                i32=rec.i32.at[rows].set(0),
+                f32=rec.f32.at[rows].set(0.0),
+                vec=rec.vec.at[rows].set(0.0),
+                used=rec.used.at[rows].set(False),
+            )
+        cs = cs.replace(
+            i32=cs.i32.at[rows].set(i32) if spec.n_i32 else cs.i32,
+            f32=cs.f32.at[rows].set(f32) if spec.n_f32 else cs.f32,
+            vec=cs.vec.at[rows].set(vec) if spec.n_vec else cs.vec,
+            alive=cs.alive.at[rows].set(True),
+            timers=timers,
+            records=records,
+        )
+        new_classes = dict(state.classes)
+        new_classes[class_name] = cs
+        return state.replace(classes=new_classes), out_guids, rows
+
+    def destroy_object(self, state: WorldState, guid: Guid) -> WorldState:
+        class_name, row = self.row_of(guid)
+        host = self._hosts[class_name]
+        cs = state.classes[class_name]
+        cs = cs.replace(
+            alive=cs.alive.at[row].set(False),
+            timers=cs.timers.replace(active=cs.timers.active.at[row].set(False)),
+        )
+        del self.guid_map[guid]
+        host.release(row)
+        new_classes = dict(state.classes)
+        new_classes[class_name] = cs
+        return state.replace(classes=new_classes)
+
+    def reconcile_deaths(self, state: WorldState, class_name: str) -> List[Guid]:
+        """Sync host allocation with rows whose `alive` was cleared on
+        device (in-tick deaths).  Returns the guids destroyed.  The device
+        never allocates — it only kills — so host free-lists stay exact."""
+        host = self._hosts[class_name]
+        alive = np.asarray(state.classes[class_name].alive)
+        dead: List[Guid] = []
+        for row, g in enumerate(host.row_guid):
+            if g is not None and not alive[row]:
+                dead.append(g)
+                del self.guid_map[g]
+                host.release(row)
+        return dead
+
+    # -- typed property access (host control plane) -------------------------
+
+    def set_property(
+        self, state: WorldState, guid: Guid, prop_name: str, value: Value
+    ) -> WorldState:
+        class_name, row = self.row_of(guid)
+        spec = self.spec(class_name)
+        slot = spec.slot(prop_name)
+        enc = self.encode(slot.prop.type, value)
+        cs = state.classes[class_name]
+        if slot.bank == Bank.I32:
+            cs = cs.replace(i32=cs.i32.at[row, slot.col].set(enc))
+        elif slot.bank == Bank.F32:
+            cs = cs.replace(f32=cs.f32.at[row, slot.col].set(enc))
+        else:
+            cs = cs.replace(vec=cs.vec.at[row, slot.col].set(enc))
+        new_classes = dict(state.classes)
+        new_classes[class_name] = cs
+        return state.replace(classes=new_classes)
+
+    def get_property(self, state: WorldState, guid: Guid, prop_name: str) -> Value:
+        class_name, row = self.row_of(guid)
+        spec = self.spec(class_name)
+        slot = spec.slot(prop_name)
+        cs = state.classes[class_name]
+        if slot.bank == Bank.I32:
+            raw = cs.i32[row, slot.col]
+        elif slot.bank == Bank.F32:
+            raw = cs.f32[row, slot.col]
+        else:
+            raw = cs.vec[row, slot.col]
+        return self.decode(slot.prop.type, raw)
+
+    # -- record access (host control plane) ---------------------------------
+
+    def _rec(self, class_name: str, record_name: str) -> RecordSpec:
+        return self.spec(class_name).records[record_name]
+
+    def record_add_row(
+        self,
+        state: WorldState,
+        guid: Guid,
+        record_name: str,
+        row_values: Dict[str, Value],
+    ) -> Tuple[WorldState, int]:
+        """Append a row into the first unused slot (reference
+        NFCRecord::AddRow semantics)."""
+        class_name, row = self.row_of(guid)
+        rs = self._rec(class_name, record_name)
+        rec = state.classes[class_name].records[record_name]
+        used = np.asarray(rec.used[row])
+        free = np.flatnonzero(~used)
+        if free.size == 0:
+            raise RuntimeError(f"record {record_name!r} full ({rs.max_rows} rows)")
+        r = int(free[0])
+        # write defaults for unspecified columns so a reused slot cannot
+        # expose the deleted row's data (reference AddRow sets every cell)
+        full: Dict[str, Value] = {
+            tag: default_value(rs.cols[tag].col_def.type) for tag in rs.col_order
+        }
+        full.update(row_values)
+        state = self._record_write(state, class_name, row, record_name, r, full)
+        cs = state.classes[class_name]
+        rec = cs.records[record_name]
+        rec = rec.replace(used=rec.used.at[row, r].set(True))
+        recs = dict(cs.records)
+        recs[record_name] = rec
+        new_classes = dict(state.classes)
+        new_classes[class_name] = cs.replace(records=recs)
+        return state.replace(classes=new_classes), r
+
+    def record_remove_row(
+        self, state: WorldState, guid: Guid, record_name: str, rec_row: int
+    ) -> WorldState:
+        class_name, row = self.row_of(guid)
+        cs = state.classes[class_name]
+        rec = cs.records[record_name]
+        rec = rec.replace(used=rec.used.at[row, rec_row].set(False))
+        recs = dict(cs.records)
+        recs[record_name] = rec
+        new_classes = dict(state.classes)
+        new_classes[class_name] = cs.replace(records=recs)
+        return state.replace(classes=new_classes)
+
+    def record_set(
+        self,
+        state: WorldState,
+        guid: Guid,
+        record_name: str,
+        rec_row: int,
+        tag: str,
+        value: Value,
+    ) -> WorldState:
+        class_name, row = self.row_of(guid)
+        return self._record_write(
+            state, class_name, row, record_name, rec_row, {tag: value}
+        )
+
+    def record_get(
+        self, state: WorldState, guid: Guid, record_name: str, rec_row: int, tag: str
+    ) -> Value:
+        class_name, row = self.row_of(guid)
+        rs = self._rec(class_name, record_name)
+        slot = rs.cols[tag]
+        rec = state.classes[class_name].records[record_name]
+        if slot.bank == Bank.I32:
+            raw = rec.i32[row, rec_row, slot.col]
+        elif slot.bank == Bank.F32:
+            raw = rec.f32[row, rec_row, slot.col]
+        else:
+            raw = rec.vec[row, rec_row, slot.col]
+        return self.decode(slot.col_def.type, raw)
+
+    def record_find_rows(
+        self, state: WorldState, guid: Guid, record_name: str, tag: str, value: Value
+    ) -> List[int]:
+        """Find used rows whose `tag` column equals value (reference
+        NFCRecord::FindInt/FindString family)."""
+        class_name, row = self.row_of(guid)
+        rs = self._rec(class_name, record_name)
+        slot = rs.cols[tag]
+        rec = state.classes[class_name].records[record_name]
+        enc = self.encode(slot.col_def.type, value)
+        if slot.bank == Bank.I32:
+            col = np.asarray(rec.i32[row, :, slot.col])
+        elif slot.bank == Bank.F32:
+            col = np.asarray(rec.f32[row, :, slot.col])
+        else:
+            raise TypeError("find on vector columns unsupported")
+        used = np.asarray(rec.used[row])
+        return [int(i) for i in np.flatnonzero(used & (col == enc))]
+
+    def _record_write(
+        self,
+        state: WorldState,
+        class_name: str,
+        row: int,
+        record_name: str,
+        rec_row: int,
+        row_values: Dict[str, Value],
+    ) -> WorldState:
+        rs = self._rec(class_name, record_name)
+        cs = state.classes[class_name]
+        rec = cs.records[record_name]
+        i32, f32, vec = rec.i32, rec.f32, rec.vec
+        for tag, v in row_values.items():
+            slot = rs.cols[tag]
+            enc = self.encode(slot.col_def.type, v)
+            if slot.bank == Bank.I32:
+                i32 = i32.at[row, rec_row, slot.col].set(enc)
+            elif slot.bank == Bank.F32:
+                f32 = f32.at[row, rec_row, slot.col].set(enc)
+            else:
+                vec = vec.at[row, rec_row, slot.col].set(enc)
+        rec = rec.replace(i32=i32, f32=f32, vec=vec)
+        recs = dict(cs.records)
+        recs[record_name] = rec
+        new_classes = dict(state.classes)
+        new_classes[class_name] = cs.replace(records=recs)
+        return state.replace(classes=new_classes)
+
+    # -- column views (device fast path) ------------------------------------
+
+    def column(self, state: WorldState, class_name: str, prop_name: str) -> jnp.ndarray:
+        """Whole property column [C] (or [C,3] for vectors) — the device
+        fast path used inside jitted module phases."""
+        slot = self.spec(class_name).slot(prop_name)
+        cs = state.classes[class_name]
+        if slot.bank == Bank.I32:
+            return cs.i32[:, slot.col]
+        if slot.bank == Bank.F32:
+            return cs.f32[:, slot.col]
+        return cs.vec[:, slot.col]
+
+    def with_column(
+        self, state: WorldState, class_name: str, prop_name: str, col: jnp.ndarray
+    ) -> WorldState:
+        slot = self.spec(class_name).slot(prop_name)
+        cs = state.classes[class_name]
+        if slot.bank == Bank.I32:
+            cs = cs.replace(i32=cs.i32.at[:, slot.col].set(col))
+        elif slot.bank == Bank.F32:
+            cs = cs.replace(f32=cs.f32.at[:, slot.col].set(col))
+        else:
+            cs = cs.replace(vec=cs.vec.at[:, slot.col].set(col))
+        new_classes = dict(state.classes)
+        new_classes[class_name] = cs
+        return state.replace(classes=new_classes)

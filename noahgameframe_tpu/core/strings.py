@@ -1,0 +1,59 @@
+"""Host-side string interning.
+
+TDATA_STRING properties (names, ConfigIDs, prefab paths) cannot live on
+device; they are interned to dense int32 handles.  Handle 0 is always the
+empty string so zero-initialised device columns decode to "".
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List
+
+
+class StringTable:
+    """Bidirectional str<->int32 intern table. Append-only, thread-safe."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._to_id: Dict[str, int] = {"": 0}
+        self._to_str: List[str] = [""]
+
+    def intern(self, s: str) -> int:
+        if s is None:
+            s = ""
+        with self._lock:
+            h = self._to_id.get(s)
+            if h is None:
+                h = len(self._to_str)
+                self._to_id[s] = h
+                self._to_str.append(s)
+            return h
+
+    def intern_all(self, items: Iterable[str]) -> List[int]:
+        return [self.intern(s) for s in items]
+
+    def lookup(self, handle: int) -> str:
+        h = int(handle)
+        if 0 <= h < len(self._to_str):
+            return self._to_str[h]
+        raise KeyError(f"unknown string handle {h}")
+
+    def __len__(self) -> int:
+        return len(self._to_str)
+
+    def snapshot(self) -> List[str]:
+        """Copy of the table for checkpointing (index == handle)."""
+        with self._lock:
+            return list(self._to_str)
+
+    @classmethod
+    def restore(cls, items: List[str]) -> "StringTable":
+        t = cls()
+        for i, s in enumerate(items):
+            if i == 0:
+                continue
+            h = t.intern(s)
+            if h != i:
+                raise ValueError("string table restore out of order")
+        return t

@@ -1,0 +1,110 @@
+"""Shared test schema: a small IObject/Player/NPC world."""
+
+from noahgameframe_tpu.core import (
+    ClassDef,
+    ClassRegistry,
+    ElementStore,
+    EntityStore,
+    StoreConfig,
+    prop,
+    record,
+)
+
+
+def base_registry() -> ClassRegistry:
+    reg = ClassRegistry()
+    reg.define(
+        ClassDef(
+            name="IObject",
+            properties=[
+                prop("ID", "string", private=True),
+                prop("ClassName", "string", private=True),
+                prop("SceneID", "int", private=True),
+                prop("GroupID", "int", private=True),
+                prop("ConfigID", "string", private=True),
+                prop("Position", "vector3", public=True, private=True, save=True, cache=True),
+            ],
+        )
+    )
+    reg.define(
+        ClassDef(
+            name="Player",
+            parent="IObject",
+            properties=[
+                prop("Name", "string", public=True, private=True, save=True),
+                prop("Level", "int", public=True, private=True, save=True),
+                prop("EXP", "int", private=True, save=True),
+                prop("HP", "int", public=True, private=True, save=True),
+                prop("MAXHP", "int", public=True, private=True),
+                prop("MP", "int", public=True, private=True, save=True),
+                prop("Gold", "int", private=True, save=True, upload=True),
+                prop("FirstTarget", "object", public=True),
+                prop("MoveSpeed", "float", public=True),
+            ],
+            records=[
+                record(
+                    "PlayerHero",
+                    8,
+                    [
+                        ("GUID", "object"),
+                        ("ConfigID", "string"),
+                        ("Level", "int"),
+                        ("Exp", "int"),
+                    ],
+                    public=False,
+                    private=True,
+                    save=True,
+                ),
+                record(
+                    "BagItems",
+                    16,
+                    [("ItemConfig", "string"), ("Count", "int"), ("Bound", "int")],
+                    private=True,
+                    save=True,
+                ),
+            ],
+        )
+    )
+    reg.define(
+        ClassDef(
+            name="NPC",
+            parent="IObject",
+            properties=[
+                prop("HP", "int", public=True, private=True),
+                prop("MAXHP", "int", public=True),
+                prop("HPREGEN", "int"),
+                prop("ATK_VALUE", "int"),
+                prop("MoveSpeed", "float"),
+                prop("NPCType", "int"),
+                prop("SeedID", "string"),
+                prop("MasterID", "object"),
+                prop("TargetPos", "vector2"),
+            ],
+        )
+    )
+    return reg
+
+
+def make_store(cap_player: int = 64, cap_npc: int = 256, timers=None) -> EntityStore:
+    reg = base_registry()
+    cfg = StoreConfig(
+        default_capacity=32,
+        capacities={"Player": cap_player, "NPC": cap_npc},
+        timer_slots=timers or {},
+    )
+    return EntityStore(reg, cfg, class_names=["IObject", "Player", "NPC"])
+
+
+def make_elements(reg: ClassRegistry) -> ElementStore:
+    es = ElementStore(reg)
+    es.add_element(
+        "NPC",
+        "Goblin",
+        {"HP": 120, "MAXHP": 120, "HPREGEN": 3, "ATK_VALUE": 11, "MoveSpeed": 2.5},
+    )
+    es.add_element(
+        "NPC",
+        "Orc",
+        {"HP": 300, "MAXHP": 300, "HPREGEN": 7, "ATK_VALUE": 25, "MoveSpeed": 1.5},
+    )
+    return es

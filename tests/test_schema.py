@@ -1,0 +1,136 @@
+"""Schema layer: definitions, inheritance flattening, bank compilation,
+flag masks, reference-format XML loading."""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from noahgameframe_tpu.core import Bank, DataType, load_logic_class_xml
+from noahgameframe_tpu.core.schema import load_class_xml
+
+from fixtures import base_registry
+
+
+def test_inheritance_flattens_parent_first():
+    reg = base_registry()
+    spec = reg.spec("Player")
+    # parent (IObject) properties come first, in declaration order
+    assert spec.prop_order[:6] == (
+        "ID",
+        "ClassName",
+        "SceneID",
+        "GroupID",
+        "ConfigID",
+        "Position",
+    )
+    assert "HP" in spec.prop_order
+    assert spec.slots["SceneID"].prop.type == DataType.INT
+
+
+def test_bank_compilation_partitions_by_dtype():
+    reg = base_registry()
+    spec = reg.spec("Player")
+    # every property landed in exactly one bank with a unique column
+    for bank in Bank:
+        cols = [s.col for s in spec.bank_props(bank)]
+        assert cols == list(range(len(cols)))
+    assert spec.n_i32 + spec.n_f32 + spec.n_vec == len(spec.prop_order)
+    # strings and objects are i32 columns
+    assert spec.slots["Name"].bank == Bank.I32
+    assert spec.slots["FirstTarget"].bank == Bank.I32
+    assert spec.slots["MoveSpeed"].bank == Bank.F32
+    assert spec.slots["Position"].bank == Bank.VEC
+
+
+def test_flag_masks():
+    reg = base_registry()
+    spec = reg.spec("Player")
+    pub = spec.mask(Bank.I32, "public")
+    sav = spec.mask(Bank.I32, "save")
+    assert pub[spec.slots["HP"].col]
+    assert not pub[spec.slots["Gold"].col]
+    assert sav[spec.slots["Gold"].col]
+    up = spec.mask(Bank.I32, "upload")
+    assert up[spec.slots["Gold"].col] and up.sum() == 1
+    assert not spec.mask(Bank.VEC, "upload")[spec.slots["Position"].col]
+    assert spec.mask(Bank.VEC, "public")[spec.slots["Position"].col]
+
+
+def test_record_spec():
+    reg = base_registry()
+    spec = reg.spec("Player")
+    rs = spec.records["PlayerHero"]
+    assert rs.max_rows == 8
+    assert rs.col_order == ("GUID", "ConfigID", "Level", "Exp")
+    assert rs.n_i32 == 4 and rs.n_f32 == 0
+    assert rs.cols["Level"].bank == Bank.I32
+
+
+def test_duplicate_class_rejected():
+    reg = base_registry()
+    from noahgameframe_tpu.core import ClassDef
+
+    with pytest.raises(ValueError):
+        reg.define(ClassDef(name="Player"))
+
+
+def test_load_reference_format_xml(tmp_path):
+    """Loader accepts the reference's on-disk format (LogicClass tree +
+    per-class Propertys/Records XML), verified against a synthetic config
+    written in that format."""
+    (tmp_path / "Struct" / "Class").mkdir(parents=True)
+    (tmp_path / "Struct" / "LogicClass.xml").write_text(
+        textwrap.dedent(
+            """\
+            <XML>
+              <Class Id="IObject" Path="Struct/Class/IObject.xml" InstancePath="">
+                <Class Id="Mob" Path="Struct/Class/Mob.xml" InstancePath="Ini/Mob.xml"/>
+              </Class>
+            </XML>
+            """
+        )
+    )
+    (tmp_path / "Struct" / "Class" / "IObject.xml").write_text(
+        textwrap.dedent(
+            """\
+            <XML>
+              <Propertys>
+                <Property Id="ID" Type="string" Public="0" Private="1"/>
+                <Property Id="SceneID" Type="int" Public="0" Private="1"/>
+                <Property Id="X" Type="float" Public="1" Private="1" Save="1" Cache="1"/>
+              </Propertys>
+            </XML>
+            """
+        )
+    )
+    (tmp_path / "Struct" / "Class" / "Mob.xml").write_text(
+        textwrap.dedent(
+            """\
+            <XML>
+              <Propertys>
+                <Property Id="HP" Type="int" Public="1" Private="1" Save="1"/>
+                <Property Id="Master" Type="object" Public="0"/>
+              </Propertys>
+              <Records>
+                <Record Id="Drops" Row="4" Col="2" Public="0" Private="1" Save="1">
+                  <Col Type="string" Tag="ItemID"/>
+                  <Col Type="int" Tag="Count"/>
+                </Record>
+              </Records>
+              <Components>
+                <Component Name="AI" Language="python" Enable="1"/>
+              </Components>
+            </XML>
+            """
+        )
+    )
+    reg = load_logic_class_xml(tmp_path / "Struct" / "LogicClass.xml", data_root=tmp_path)
+    assert "Mob" in reg and "IObject" in reg
+    spec = reg.spec("Mob")
+    assert spec.prop_order == ("ID", "SceneID", "X", "HP", "Master")
+    assert spec.slots["X"].prop.save and spec.slots["X"].prop.cache
+    assert spec.records["Drops"].max_rows == 4
+    flat = reg._flatten("Mob")
+    assert flat.components[0].name == "AI"
+    assert reg.get_def("Mob").instance_path == "Ini/Mob.xml"

@@ -1,0 +1,226 @@
+"""SoA entity store: allocation, typed access, records, handles, deaths."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from noahgameframe_tpu.core import Guid, NULL_GUID, unpack_handle
+
+from fixtures import make_elements, make_store
+
+
+def test_create_and_get_defaults():
+    store = make_store()
+    state = store.init_state()
+    state, g, row = store.create_object(state, "Player", values={"Name": "alice", "HP": 100})
+    assert store.get_property(state, g, "Name") == "alice"
+    assert store.get_property(state, g, "HP") == 100
+    assert store.get_property(state, g, "Level") == 0
+    assert store.get_property(state, g, "FirstTarget") == NULL_GUID
+    assert store.get_property(state, g, "Position") == (0.0, 0.0, 0.0)
+    assert bool(state.classes["Player"].alive[row])
+    assert store.live_count("Player") == 1
+
+
+def test_set_property_all_types():
+    store = make_store()
+    state = store.init_state()
+    state, g, _ = store.create_object(state, "Player")
+    state, g2, _ = store.create_object(state, "Player")
+    state = store.set_property(state, g, "HP", 55)
+    state = store.set_property(state, g, "Name", "bob")
+    state = store.set_property(state, g, "MoveSpeed", 3.25)
+    state = store.set_property(state, g, "Position", (1.0, 2.0, 3.0))
+    state = store.set_property(state, g, "FirstTarget", g2)
+    assert store.get_property(state, g, "HP") == 55
+    assert store.get_property(state, g, "Name") == "bob"
+    assert store.get_property(state, g, "MoveSpeed") == 3.25
+    assert store.get_property(state, g, "Position") == (1.0, 2.0, 3.0)
+    assert store.get_property(state, g, "FirstTarget") == g2
+
+
+def test_guid_handle_roundtrip():
+    store = make_store()
+    state = store.init_state()
+    state, g, row = store.create_object(state, "NPC")
+    h = store.handle_of(g)
+    ci, r = unpack_handle(h)
+    assert store.class_order[ci] == "NPC" and r == row
+    assert store.guid_of_handle(h) == g
+
+
+def test_destroy_recycles_row():
+    store = make_store()
+    state = store.init_state()
+    state, g1, row1 = store.create_object(state, "NPC")
+    state = store.destroy_object(state, g1)
+    assert store.live_count("NPC") == 0
+    assert not bool(state.classes["NPC"].alive[row1])
+    state, g2, row2 = store.create_object(state, "NPC")
+    assert row2 == row1  # LIFO free list reuses the row
+    with pytest.raises(KeyError):
+        store.row_of(g1)
+
+
+def test_create_many_bulk():
+    store = make_store(cap_npc=512)
+    state = store.init_state()
+    hps = list(range(100))
+    state, guids, rows = store.create_many(
+        state, "NPC", 100, values={"HP": hps, "MoveSpeed": [0.5] * 100}
+    )
+    assert len(set(rows.tolist())) == 100
+    assert store.live_count("NPC") == 100
+    col = np.asarray(store.column(state, "NPC", "HP"))
+    assert sorted(col[rows].tolist()) == sorted(hps)
+
+
+def test_capacity_exhaustion():
+    store = make_store(cap_player=2)
+    state = store.init_state()
+    state, _, _ = store.create_object(state, "Player")
+    state, _, _ = store.create_object(state, "Player")
+    with pytest.raises(RuntimeError):
+        store.create_object(state, "Player")
+
+
+def test_records_add_set_find_remove():
+    store = make_store()
+    state = store.init_state()
+    state, g, _ = store.create_object(state, "Player")
+    state, r0 = store.record_add_row(
+        state, g, "BagItems", {"ItemConfig": "potion", "Count": 5, "Bound": 1}
+    )
+    state, r1 = store.record_add_row(
+        state, g, "BagItems", {"ItemConfig": "sword", "Count": 1, "Bound": 0}
+    )
+    assert (r0, r1) == (0, 1)
+    assert store.record_get(state, g, "BagItems", 0, "ItemConfig") == "potion"
+    assert store.record_get(state, g, "BagItems", 1, "Count") == 1
+    state = store.record_set(state, g, "BagItems", 0, "Count", 9)
+    assert store.record_get(state, g, "BagItems", 0, "Count") == 9
+    assert store.record_find_rows(state, g, "BagItems", "ItemConfig", "sword") == [1]
+    state = store.record_remove_row(state, g, "BagItems", 0)
+    assert store.record_find_rows(state, g, "BagItems", "ItemConfig", "potion") == []
+    # removed row becomes reusable
+    state, r2 = store.record_add_row(state, g, "BagItems", {"ItemConfig": "shield"})
+    assert r2 == 0
+
+
+def test_record_object_column_stores_handles():
+    store = make_store()
+    state = store.init_state()
+    state, owner, _ = store.create_object(state, "Player")
+    state, hero, _ = store.create_object(state, "Player")
+    state, r = store.record_add_row(
+        state, owner, "PlayerHero", {"GUID": hero, "ConfigID": "hero_1", "Level": 3}
+    )
+    assert store.record_get(state, owner, "PlayerHero", r, "GUID") == hero
+
+
+def test_device_deaths_reconcile():
+    store = make_store(cap_npc=16)
+    state = store.init_state()
+    state, guids, rows = store.create_many(state, "NPC", 4)
+    # simulate an in-tick death: device clears alive for two rows
+    cs = state.classes["NPC"]
+    dead_rows = rows[:2]
+    cs = cs.replace(alive=cs.alive.at[jnp.asarray(dead_rows)].set(False))
+    state = state.replace(classes={**state.classes, "NPC": cs})
+    dead = store.reconcile_deaths(state, "NPC")
+    assert sorted(str(g) for g in dead) == sorted(str(g) for g in guids[:2])
+    assert store.live_count("NPC") == 2
+
+
+def test_element_table_gather():
+    store = make_store()
+    es = make_elements(store.registry)
+    tab = es.table("NPC")
+    assert tab.index["Goblin"] == 0 and tab.index["Orc"] == 1
+    spec = store.registry.spec("NPC")
+    hp_col = spec.slots["HP"].col
+    assert tab.i32[tab.index["Orc"], hp_col] == 300
+    ms_col = spec.slots["MoveSpeed"].col
+    assert tab.f32[tab.index["Goblin"], ms_col] == np.float32(2.5)
+    # host getter API
+    assert es.get_int("Orc", "ATK_VALUE") == 25
+    assert es.get_int("Missing", "ATK_VALUE") == 0
+
+
+def test_column_view_and_with_column():
+    store = make_store(cap_npc=8)
+    state = store.init_state()
+    state, guids, rows = store.create_many(state, "NPC", 3, values={"HP": [10, 20, 30]})
+    col = store.column(state, "NPC", "HP")
+    state = store.with_column(state, "NPC", "HP", col + 5)
+    assert store.get_property(state, guids[1], "HP") == 25
+
+
+def test_recycled_row_is_fully_reset():
+    """Regression: a recycled row must not leak the dead entity's records."""
+    store = make_store()
+    state = store.init_state()
+    state, g, row = store.create_object(state, "Player")
+    state, _ = store.record_add_row(state, g, "BagItems", {"ItemConfig": "potion", "Count": 5})
+    state = store.destroy_object(state, g)
+    state, g2, row2 = store.create_object(state, "Player")
+    assert row2 == row
+    assert store.record_find_rows(state, g2, "BagItems", "ItemConfig", "potion") == []
+    state, r = store.record_add_row(state, g2, "BagItems", {"ItemConfig": "shield"})
+    assert r == 0  # appends at the top, not after stale rows
+
+
+def test_record_slot_reuse_resets_unspecified_columns():
+    """Regression: reusing a removed record slot writes defaults."""
+    store = make_store()
+    state = store.init_state()
+    state, g, _ = store.create_object(state, "Player")
+    state, _ = store.record_add_row(
+        state, g, "BagItems", {"ItemConfig": "potion", "Count": 9, "Bound": 1}
+    )
+    state = store.record_remove_row(state, g, "BagItems", 0)
+    state, r = store.record_add_row(state, g, "BagItems", {"ItemConfig": "shield"})
+    assert r == 0
+    assert store.record_get(state, g, "BagItems", 0, "Count") == 0
+    assert store.record_get(state, g, "BagItems", 0, "Bound") == 0
+
+
+def test_create_many_duplicate_guid_leaks_nothing():
+    """Regression: a rejected batch must not consume rows or guids."""
+    store = make_store(cap_npc=8)
+    state = store.init_state()
+    state, g1, _ = store.create_object(state, "NPC")
+    free_before = store.capacity("NPC") - store.live_count("NPC")
+    with pytest.raises(ValueError):
+        store.create_many(state, "NPC", 2, guids=[Guid(9, 9), g1])
+    assert store.capacity("NPC") - store.live_count("NPC") == free_before
+    assert Guid(9, 9) not in store.guid_map
+
+
+def test_null_object_handle_decodes():
+    store = make_store()
+    assert store.guid_of_handle(-1) is None
+    state = store.init_state()
+    state, g, _ = store.create_object(state, "NPC")
+    # zero-init OBJECT columns hold NULL after explicit null store
+    state = store.set_property(state, g, "MasterID", NULL_GUID)
+    assert store.get_property(state, g, "MasterID") == NULL_GUID
+
+
+def test_object_property_accepts_raw_handle():
+    store = make_store()
+    state = store.init_state()
+    state, g1, _ = store.create_object(state, "NPC")
+    state, g2, _ = store.create_object(state, "NPC")
+    h = store.handle_of(g1)
+    state = store.set_property(state, g2, "MasterID", h)
+    assert store.get_property(state, g2, "MasterID") == g1
+
+
+def test_duplicate_property_name_rejected():
+    from noahgameframe_tpu.core import ClassDef, ClassRegistry, prop as P
+
+    reg = ClassRegistry()
+    reg.define(ClassDef(name="Bad", properties=[P("HP", "int"), P("HP", "int")]))
+    with pytest.raises(ValueError):
+        reg.spec("Bad")
