@@ -59,6 +59,13 @@ HANDLE_ROW_BITS = 24
 HANDLE_ROW_MASK = (1 << HANDLE_ROW_BITS) - 1
 
 
+def with_class(state: "WorldState", class_name: str, cs: "ClassState") -> "WorldState":
+    """Functional single-class replacement — the universal update idiom."""
+    new_classes = dict(state.classes)
+    new_classes[class_name] = cs
+    return state.replace(classes=new_classes)
+
+
 def pack_handle(class_idx: int, row: int) -> int:
     return (class_idx << HANDLE_ROW_BITS) | row
 
@@ -321,29 +328,9 @@ class EntityStore:
         by NPC seeding and the benchmarks."""
         host = self._hosts[class_name]
         spec = host.spec
-        # validate identities BEFORE allocating so a failure leaks nothing
-        if guids is not None:
-            if len(guids) != n:
-                raise ValueError("guids length must equal n")
-            if len({*guids}) != n:
-                raise ValueError("duplicate guids in create_many batch")
-            for g in guids:
-                if g in self.guid_map:
-                    raise ValueError(f"guid {g} already exists")
-        if len(host.free) < n:
-            raise RuntimeError(
-                f"class {spec.name!r} capacity {host.capacity} exhausted "
-                f"({len(host.free)} free, {n} requested)"
-            )
-        rows = np.asarray([host.alloc() for _ in range(n)], np.int32)
-        out_guids: List[Guid] = []
-        for i in range(n):
-            g = guids[i] if guids is not None else self.guids.next()
-            self.guid_map[g] = pack_handle(host.class_idx, int(rows[i]))
-            host.row_guid[int(rows[i])] = g
-            out_guids.append(g)
-
-        # column payloads: defaults then overrides
+        # Stage ALL payloads and validate identities BEFORE touching any
+        # host bookkeeping, so a bad property name, unknown guid, or full
+        # class leaks nothing.
         i32 = np.zeros((n, spec.n_i32), np.int32)
         f32 = np.zeros((n, spec.n_f32), np.float32)
         vec = np.zeros((n, spec.n_vec, 3), np.float32)
@@ -366,6 +353,26 @@ class EntityStore:
                     f32[:, slot.col] = np.asarray(enc, np.float32)
                 else:
                     vec[:, slot.col] = np.asarray(enc, np.float32)
+        if guids is not None:
+            if len(guids) != n:
+                raise ValueError("guids length must equal n")
+            if len({*guids}) != n:
+                raise ValueError("duplicate guids in create_many batch")
+            for g in guids:
+                if g in self.guid_map:
+                    raise ValueError(f"guid {g} already exists")
+        if len(host.free) < n:
+            raise RuntimeError(
+                f"class {spec.name!r} capacity {host.capacity} exhausted "
+                f"({len(host.free)} free, {n} requested)"
+            )
+        rows = np.asarray([host.alloc() for _ in range(n)], np.int32)
+        out_guids: List[Guid] = []
+        for i in range(n):
+            g = guids[i] if guids is not None else self.guids.next()
+            self.guid_map[g] = pack_handle(host.class_idx, int(rows[i]))
+            host.row_guid[int(rows[i])] = g
+            out_guids.append(g)
 
         cs = state.classes[class_name]
         # fully reset the rows: banks to defaults/overrides, timers off, and
@@ -394,9 +401,7 @@ class EntityStore:
             timers=timers,
             records=records,
         )
-        new_classes = dict(state.classes)
-        new_classes[class_name] = cs
-        return state.replace(classes=new_classes), out_guids, rows
+        return with_class(state, class_name, cs), out_guids, rows
 
     def destroy_object(self, state: WorldState, guid: Guid) -> WorldState:
         class_name, row = self.row_of(guid)
@@ -408,9 +413,7 @@ class EntityStore:
         )
         del self.guid_map[guid]
         host.release(row)
-        new_classes = dict(state.classes)
-        new_classes[class_name] = cs
-        return state.replace(classes=new_classes)
+        return with_class(state, class_name, cs)
 
     def reconcile_deaths(self, state: WorldState, class_name: str) -> List[Guid]:
         """Sync host allocation with rows whose `alive` was cleared on
@@ -442,9 +445,7 @@ class EntityStore:
             cs = cs.replace(f32=cs.f32.at[row, slot.col].set(enc))
         else:
             cs = cs.replace(vec=cs.vec.at[row, slot.col].set(enc))
-        new_classes = dict(state.classes)
-        new_classes[class_name] = cs
-        return state.replace(classes=new_classes)
+        return with_class(state, class_name, cs)
 
     def get_property(self, state: WorldState, guid: Guid, prop_name: str) -> Value:
         class_name, row = self.row_of(guid)
@@ -491,11 +492,7 @@ class EntityStore:
         cs = state.classes[class_name]
         rec = cs.records[record_name]
         rec = rec.replace(used=rec.used.at[row, r].set(True))
-        recs = dict(cs.records)
-        recs[record_name] = rec
-        new_classes = dict(state.classes)
-        new_classes[class_name] = cs.replace(records=recs)
-        return state.replace(classes=new_classes), r
+        return with_class(state, class_name, cs.replace(records={**cs.records, record_name: rec})), r
 
     def record_remove_row(
         self, state: WorldState, guid: Guid, record_name: str, rec_row: int
@@ -504,11 +501,7 @@ class EntityStore:
         cs = state.classes[class_name]
         rec = cs.records[record_name]
         rec = rec.replace(used=rec.used.at[row, rec_row].set(False))
-        recs = dict(cs.records)
-        recs[record_name] = rec
-        new_classes = dict(state.classes)
-        new_classes[class_name] = cs.replace(records=recs)
-        return state.replace(classes=new_classes)
+        return with_class(state, class_name, cs.replace(records={**cs.records, record_name: rec}))
 
     def record_set(
         self,
@@ -581,11 +574,7 @@ class EntityStore:
             else:
                 vec = vec.at[row, rec_row, slot.col].set(enc)
         rec = rec.replace(i32=i32, f32=f32, vec=vec)
-        recs = dict(cs.records)
-        recs[record_name] = rec
-        new_classes = dict(state.classes)
-        new_classes[class_name] = cs.replace(records=recs)
-        return state.replace(classes=new_classes)
+        return with_class(state, class_name, cs.replace(records={**cs.records, record_name: rec}))
 
     # -- column views (device fast path) ------------------------------------
 
@@ -611,6 +600,4 @@ class EntityStore:
             cs = cs.replace(f32=cs.f32.at[:, slot.col].set(col))
         else:
             cs = cs.replace(vec=cs.vec.at[:, slot.col].set(col))
-        new_classes = dict(state.classes)
-        new_classes[class_name] = cs
-        return state.replace(classes=new_classes)
+        return with_class(state, class_name, cs)

@@ -1,0 +1,19 @@
+from .events import DeviceEvent, EventModule
+from .kernel import Kernel, ObjectEvent, TickCtx, TickOutputs
+from .module import Module, Phase
+from .plugin import Plugin, PluginManager
+from .schedule import ScheduleModule
+
+__all__ = [
+    "DeviceEvent",
+    "EventModule",
+    "Kernel",
+    "Module",
+    "ObjectEvent",
+    "Phase",
+    "Plugin",
+    "PluginManager",
+    "ScheduleModule",
+    "TickCtx",
+    "TickOutputs",
+]

@@ -1,0 +1,119 @@
+"""Event module: integer-ID pub/sub plus device-emitted batch events.
+
+Host side mirrors the reference NFCEventModule: module-scope and per-object
+subscriptions on integer event IDs, synchronous fan-out, removals deferred
+to end-of-frame so handlers may unsubscribe during dispatch
+(NFCEventModule.cpp:36-110).
+
+Device side is the batch replacement for "fire an event per entity": a
+phase calls `ctx.emit(event_id, class_name, mask, **params)` with a [C]
+boolean mask (and optional per-entity param columns).  The kernel returns
+these buffers from the jitted tick; after the step the event module fans
+each one out — batch subscribers get the raw (mask, params) arrays, object
+subscribers get scalar calls for their row only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.datatypes import Guid
+from .module import Module
+
+# host handler signatures
+ObjectEventFn = Callable[[Guid, int, Dict[str, Any]], None]
+BatchEventFn = Callable[[str, np.ndarray, Dict[str, np.ndarray]], None]
+
+
+@dataclasses.dataclass
+class DeviceEvent:
+    """One batch event emitted by a device phase during a tick."""
+
+    event_id: int
+    class_name: str
+    mask: Any  # bool [C] (jnp during trace, np after fetch)
+    params: Dict[str, Any]
+
+
+class EventModule(Module):
+    name = "EventModule"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._module_subs: Dict[int, List[ObjectEventFn]] = {}
+        self._object_subs: Dict[Tuple[Guid, int], List[ObjectEventFn]] = {}
+        self._batch_subs: Dict[int, List[BatchEventFn]] = {}
+        self._pending_removals: List[Tuple[str, Any]] = []
+
+    # -- subscribe / unsubscribe -------------------------------------------
+
+    def subscribe(self, event_id: int, fn: ObjectEventFn) -> None:
+        self._module_subs.setdefault(int(event_id), []).append(fn)
+
+    def subscribe_object(self, guid: Guid, event_id: int, fn: ObjectEventFn) -> None:
+        self._object_subs.setdefault((guid, int(event_id)), []).append(fn)
+
+    def subscribe_batch(self, event_id: int, fn: BatchEventFn) -> None:
+        """Batch subscriber: receives (class_name, mask[C], params) per
+        device event — the TPU-native consumption path."""
+        self._batch_subs.setdefault(int(event_id), []).append(fn)
+
+    def unsubscribe(self, event_id: int) -> None:
+        self._pending_removals.append(("module", int(event_id)))
+
+    def unsubscribe_object(self, guid: Guid, event_id: int) -> None:
+        self._pending_removals.append(("object", (guid, int(event_id))))
+
+    # -- host-originated synchronous dispatch ------------------------------
+
+    def do_event(self, guid: Guid, event_id: int, args: Optional[Dict[str, Any]] = None) -> int:
+        """Synchronous fan-out to object-scope then module-scope handlers;
+        returns number of handlers invoked."""
+        args = args or {}
+        n = 0
+        for fn in list(self._object_subs.get((guid, int(event_id)), ())):
+            fn(guid, int(event_id), args)
+            n += 1
+        for fn in list(self._module_subs.get(int(event_id), ())):
+            fn(guid, int(event_id), args)
+            n += 1
+        return n
+
+    # -- device event fan-out (called by the kernel after each tick) -------
+
+    def dispatch_device_events(self, events: List[DeviceEvent], store) -> None:
+        for ev in events:
+            mask = np.asarray(ev.mask)
+            if not mask.any():
+                continue
+            params_np = {k: np.asarray(v) for k, v in ev.params.items()}
+            for fn in list(self._batch_subs.get(ev.event_id, ())):
+                fn(ev.class_name, mask, params_np)
+            # per-object subscribers, only for rows they watch
+            if self._object_subs or self._module_subs:
+                rows = np.flatnonzero(mask)
+                host = store._hosts[ev.class_name]
+                for row in rows:
+                    g = host.row_guid[int(row)]
+                    if g is None:
+                        continue
+                    scalar_args = {k: v[int(row)] for k, v in params_np.items()}
+                    if (g, ev.event_id) in self._object_subs or self._module_subs.get(
+                        ev.event_id
+                    ):
+                        self.do_event(g, ev.event_id, scalar_args)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def execute(self) -> None:
+        """Drain deferred removals (reference drains its removal lists in
+        Execute, NFCEventModule.cpp:36-66)."""
+        for kind, key in self._pending_removals:
+            if kind == "module":
+                self._module_subs.pop(key, None)
+            else:
+                self._object_subs.pop(key, None)
+        self._pending_removals.clear()

@@ -1,0 +1,422 @@
+"""The kernel: object lifecycle + the jit-compiled world tick.
+
+Reference equivalent: NFCKernelModule (object store, create/destroy with the
+COE_* create-event chain, property/record access by GUID, common event
+fan-out) plus the per-frame Execute loop over every object
+(NFCKernelModule.cpp:70-99, 251-308).  Here the per-frame work is ONE
+compiled function:
+
+    state', outputs = step(state)
+
+where `step` = schedule advance (vectorised heartbeats) → registered module
+phases in order → dirty-diff extraction + death detection, all fused by XLA.
+Host-side reactive semantics (the mutate → flags decide visibility →
+subscribers converge chain, SURVEY §3.3) are preserved batch-wise: the tick
+returns per-bank changed masks (pre-masked by the Public/Upload flags) and
+per-class death masks; the kernel fans those out to host subscribers after
+each tick, fetching device data only when someone is listening.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.datatypes import Bank, Guid, Value
+from ..core.element import ElementStore
+from ..core.schema import ClassRegistry
+from ..core.store import EntityStore, StoreConfig, WorldState
+from .events import DeviceEvent, EventModule
+from .module import Module, Phase
+from .schedule import ScheduleModule
+
+
+class ObjectEvent(enum.IntEnum):
+    """Create/destroy state chain, mirroring the reference's
+    CLASS_OBJECT_EVENT / COE_* states (NFIObject.h:22-30)."""
+
+    CREATE_NODATA = 0
+    CREATE_LOADDATA = 1
+    CREATE_BEFORE_EFFECT = 2
+    CREATE_EFFECTDATA = 3
+    CREATE_AFTER_EFFECT = 4
+    CREATE_HASDATA = 5
+    CREATE_FINISH = 6
+    BEFORE_DESTROY = 7
+    DESTROY = 8
+
+ClassEventFn = Callable[[Guid, str, "ObjectEvent"], None]
+PropertyEventFn = Callable[[str, str, np.ndarray], None]  # (class, prop, changed_rows)
+
+
+class TickCtx:
+    """Per-tick context handed to device phases during tracing."""
+
+    def __init__(
+        self,
+        kernel: "Kernel",
+        tick: jnp.ndarray,
+        rng: jnp.ndarray,
+        fired_masks: Dict[str, jnp.ndarray],
+    ):
+        self.kernel = kernel
+        self.store = kernel.store
+        self.tick = tick
+        self.dt = kernel.schedule.dt
+        self._rng = rng
+        self._rng_count = 0
+        self._fired = fired_masks
+        self.emitted: List[DeviceEvent] = []
+
+    def fired(self, class_name: str, timer_name: str) -> jnp.ndarray:
+        """[C] bool — which entities' `timer_name` fired this tick."""
+        slot = self.kernel.schedule.slot(class_name, timer_name)
+        return self._fired[class_name][:, slot]
+
+    def rng(self) -> jnp.ndarray:
+        """A fresh PRNG key (deterministic per tick + call position)."""
+        self._rng_count += 1
+        return jax.random.fold_in(self._rng, self._rng_count)
+
+    def emit(
+        self, event_id: int, class_name: str, mask: jnp.ndarray, **params: jnp.ndarray
+    ) -> None:
+        """Emit a batch event from inside the tick; delivered to host/batch
+        subscribers after the step (device replacement for DoEvent).
+
+        The (event_id, class_name) metadata is static per compilation; only
+        mask/params are traced values."""
+        self.emitted.append(DeviceEvent(int(event_id), class_name, mask, dict(params)))
+
+
+@dataclasses.dataclass
+class TickOutputs:
+    """Device-resident tick results; host fetches lazily."""
+
+    fired: Dict[str, jnp.ndarray]  # class -> [C, T] bool
+    diff: Dict[str, Dict[str, jnp.ndarray]]  # class -> bank -> [C, ncols] bool
+    diff_count: Dict[str, jnp.ndarray]  # class -> scalar changed-cell count
+    died: Dict[str, jnp.ndarray]  # class -> [C] bool
+    died_count: Dict[str, jnp.ndarray]  # class -> scalar
+    events: List[DeviceEvent]
+
+
+class Kernel(Module):
+    """Owns the world: registry + store + state + the compiled tick."""
+
+    name = "KernelModule"
+
+    def __init__(
+        self,
+        registry: ClassRegistry,
+        store_config: Optional[StoreConfig] = None,
+        dt: float = 1.0 / 30.0,
+        seed: int = 0,
+        class_names: Optional[Sequence[str]] = None,
+        diff_flags: Tuple[str, ...] = ("public", "upload"),
+    ):
+        super().__init__()
+        self.registry = registry
+        self.store_config = store_config or StoreConfig()
+        self.schedule = ScheduleModule(dt=dt)
+        self.events = EventModule()
+        self.elements = ElementStore(registry)
+        self._class_names = class_names
+        self._seed = seed
+        self._diff_flags = diff_flags
+        self.store: Optional[EntityStore] = None
+        self.state: Optional[WorldState] = None
+        # the composed, sorted phase chain the tick runs; the kernel's OWN
+        # phases (added via Module.add_phase) stay in self._phases like any
+        # other module's so composition can't double-count them
+        self._composed: List[Phase] = []
+        self._jit_step = None
+        self._class_event_subs: List[ClassEventFn] = []
+        self._class_event_by_class: Dict[str, List[ClassEventFn]] = {}
+        self._prop_event_subs: Dict[Tuple[str, str], List[PropertyEventFn]] = {}
+        self._pending_destroy: List[Guid] = []
+        self._event_meta: List[Tuple[int, str, Tuple[str, ...]]] = []
+        self.tick_count = 0
+
+    # -- build --------------------------------------------------------------
+
+    def build(self, modules: Sequence[Module] = ()) -> None:
+        """Freeze timer slots, construct the store + initial state, and
+        collect device phases from `modules` (plus any added directly)."""
+        timer_slots = self.schedule.freeze()
+        self.store_config.timer_slots = {
+            **timer_slots,
+            **{
+                k: v
+                for k, v in self.store_config.timer_slots.items()
+                if k not in timer_slots
+            },
+        }
+        self.store = EntityStore(
+            self.registry,
+            self.store_config,
+            strings=self.elements.strings,
+            class_names=self._class_names,
+        )
+        self.state = self.store.init_state(self._seed)
+        phases: List[Phase] = []
+        seen_modules = set()
+        for m in modules:
+            phases.extend(m.phases)
+            seen_modules.add(id(m))
+        if id(self) not in seen_modules:
+            phases.extend(self.phases)
+        self.set_phases(phases)
+
+    def set_phases(self, phases: Sequence[Phase]) -> None:
+        self._composed = sorted(phases, key=lambda p: p.order)
+        self._jit_step = None
+
+    # -- the compiled tick --------------------------------------------------
+
+    def _trace_step(self, state: WorldState):
+        old = state
+        fired: Dict[str, jnp.ndarray] = {}
+        new_classes = {}
+        for cname in self.store.class_order:
+            cs, f = self.schedule.advance_class(state.classes[cname], state.tick)
+            new_classes[cname] = cs
+            fired[cname] = f
+        state = state.replace(classes=new_classes)
+
+        rng = jax.random.fold_in(state.rng, state.tick)
+        ctx = TickCtx(self, state.tick, rng, fired)
+        for phase in self._composed:
+            state = phase.fn(state, ctx)
+
+        diff: Dict[str, Dict[str, jnp.ndarray]] = {}
+        diff_count: Dict[str, jnp.ndarray] = {}
+        died: Dict[str, jnp.ndarray] = {}
+        died_count: Dict[str, jnp.ndarray] = {}
+        for cname in self.store.class_order:
+            spec = self.store.spec(cname)
+            oc, nc = old.classes[cname], state.classes[cname]
+            masks: Dict[str, jnp.ndarray] = {}
+            total = jnp.zeros((), jnp.int32)
+            flag_union = {}
+            for bank, nm in ((Bank.I32, "i32"), (Bank.F32, "f32"), (Bank.VEC, "vec")):
+                fm = np.zeros(spec.bank_size(bank), bool)
+                for fl in self._diff_flags:
+                    fm |= spec.mask(bank, fl)
+                flag_union[nm] = fm
+            if flag_union["i32"].any():
+                m = (oc.i32 != nc.i32) & nc.alive[:, None] & flag_union["i32"][None, :]
+                masks["i32"] = m
+                total = total + jnp.sum(m, dtype=jnp.int32)
+            if flag_union["f32"].any():
+                m = (oc.f32 != nc.f32) & nc.alive[:, None] & flag_union["f32"][None, :]
+                masks["f32"] = m
+                total = total + jnp.sum(m, dtype=jnp.int32)
+            if flag_union["vec"].any():
+                m = (
+                    jnp.any(oc.vec != nc.vec, axis=-1)
+                    & nc.alive[:, None]
+                    & flag_union["vec"][None, :]
+                )
+                masks["vec"] = m
+                total = total + jnp.sum(m, dtype=jnp.int32)
+            if masks:
+                diff[cname] = masks
+                diff_count[cname] = total
+            d = oc.alive & ~nc.alive
+            died[cname] = d
+            died_count[cname] = jnp.sum(d, dtype=jnp.int32)
+
+        state = state.replace(tick=state.tick + 1)
+        # static event metadata is captured on self at trace time; only the
+        # traced arrays cross the jit boundary (dataclasses aren't pytrees)
+        self._event_meta = [(e.event_id, e.class_name, tuple(e.params)) for e in ctx.emitted]
+        out = {
+            "fired": fired,
+            "diff": diff,
+            "diff_count": diff_count,
+            "died": died,
+            "died_count": died_count,
+            "events": [(e.mask, e.params) for e in ctx.emitted],
+        }
+        return state, out
+
+    def compile(self) -> None:
+        if self._jit_step is None:
+            self._jit_step = jax.jit(self._trace_step, donate_argnums=0)
+
+    def tick(self) -> TickOutputs:
+        """Advance the world one frame and fan out host-visible effects."""
+        self.compile()
+        self.state, raw = self._jit_step(self.state)
+        self.tick_count += 1
+        out = TickOutputs(
+            fired=raw["fired"],
+            diff=raw["diff"],
+            diff_count=raw["diff_count"],
+            died=raw["died"],
+            died_count=raw["died_count"],
+            events=[
+                DeviceEvent(eid, cname, mask, dict(params))
+                for (eid, cname, pnames), (mask, params) in zip(
+                    self._event_meta, raw["events"]
+                )
+            ],
+        )
+        self._post_tick(out)
+        return out
+
+    def _post_tick(self, out: TickOutputs) -> None:
+        # device-emitted events FIRST — entities that died this tick must
+        # still deliver their events (the reference fires events before
+        # destroy), so guid identities are intact here
+        if out.events:
+            self.events.dispatch_device_events(out.events, self.store)
+        # deaths: reconcile host allocation + fire destroy events
+        for cname, cnt in out.died_count.items():
+            if int(cnt) == 0:
+                continue
+            dead = self.store.reconcile_deaths(self.state, cname)
+            for g in dead:
+                self._fire_class_event(g, cname, ObjectEvent.DESTROY)
+        # property-change host subscribers (batch granularity)
+        if self._prop_event_subs:
+            for (cname, pname), fns in self._prop_event_subs.items():
+                masks = out.diff.get(cname)
+                if not masks:
+                    continue
+                if int(out.diff_count[cname]) == 0:
+                    continue
+                slot = self.store.spec(cname).slot(pname)
+                bank_name = slot.bank.value
+                m = masks.get(bank_name)
+                if m is None:
+                    continue
+                rows = np.flatnonzero(np.asarray(m[:, slot.col]))
+                if rows.size:
+                    for fn in fns:
+                        fn(cname, pname, rows)
+
+    # -- object lifecycle (host control plane) ------------------------------
+
+    def create_object(
+        self,
+        class_name: str,
+        values: Optional[Dict[str, Value]] = None,
+        guid: Optional[Guid] = None,
+        scene: int = 0,
+        group: int = 0,
+    ) -> Guid:
+        vals = dict(values or {})
+        if self.store.spec(class_name).has_property("SceneID"):
+            vals.setdefault("SceneID", scene)
+        if self.store.spec(class_name).has_property("GroupID"):
+            vals.setdefault("GroupID", group)
+        if self.store.spec(class_name).has_property("ClassName"):
+            vals.setdefault("ClassName", class_name)
+        self.state, g, _ = self.store.create_object(self.state, class_name, guid, vals)
+        if self.store.spec(class_name).has_property("ID"):
+            self.state = self.store.set_property(self.state, g, "ID", str(g))
+        # full create chain, in order (reference NFCKernelModule.cpp:251-267)
+        for ev in (
+            ObjectEvent.CREATE_NODATA,
+            ObjectEvent.CREATE_LOADDATA,
+            ObjectEvent.CREATE_BEFORE_EFFECT,
+            ObjectEvent.CREATE_EFFECTDATA,
+            ObjectEvent.CREATE_AFTER_EFFECT,
+            ObjectEvent.CREATE_HASDATA,
+            ObjectEvent.CREATE_FINISH,
+        ):
+            self._fire_class_event(g, class_name, ev)
+        return g
+
+    def create_from_element(
+        self,
+        class_name: str,
+        elem_id: str,
+        overrides: Optional[Dict[str, Value]] = None,
+        scene: int = 0,
+        group: int = 0,
+    ) -> Guid:
+        """Create seeded from element config (reference CreateObject applies
+        the element's Ref/IOBJECT property defaults)."""
+        e = self.elements.element(elem_id)
+        vals = dict(e.values)
+        vals["ConfigID"] = elem_id
+        vals.update(overrides or {})
+        vals = {
+            k: v for k, v in vals.items() if self.store.spec(class_name).has_property(k)
+        }
+        return self.create_object(class_name, vals, scene=scene, group=group)
+
+    def destroy_object(self, guid: Guid, deferred: bool = False) -> None:
+        """Destroy now, or at end of current frame if deferred (reference
+        defers self-destroys mid-tick, NFCKernelModule.cpp:273-308)."""
+        if deferred:
+            self._pending_destroy.append(guid)
+            return
+        class_name, _ = self.store.row_of(guid)
+        self._fire_class_event(guid, class_name, ObjectEvent.BEFORE_DESTROY)
+        self.state = self.store.destroy_object(self.state, guid)
+        self._fire_class_event(guid, class_name, ObjectEvent.DESTROY)
+
+    def flush_pending_destroy(self) -> int:
+        n = 0
+        for g in self._pending_destroy:
+            if g in self.store.guid_map:
+                self.destroy_object(g)
+                n += 1
+        self._pending_destroy.clear()
+        return n
+
+    def execute(self) -> None:
+        self.flush_pending_destroy()
+        self.events.execute()
+
+    # -- property access with host-callback parity --------------------------
+
+    def set_property(self, guid: Guid, prop_name: str, value: Value) -> None:
+        """Host-originated write; fires property subscribers synchronously
+        like the reference's SetProperty -> OnEventHandler chain."""
+        class_name, row = self.store.row_of(guid)
+        old = self.store.get_property(self.state, guid, prop_name)
+        self.state = self.store.set_property(self.state, guid, prop_name, value)
+        if old != value:
+            for fn in self._prop_event_subs.get((class_name, prop_name), ()):
+                fn(class_name, prop_name, np.asarray([row]))
+
+    def get_property(self, guid: Guid, prop_name: str) -> Value:
+        return self.store.get_property(self.state, guid, prop_name)
+
+    # -- event registration --------------------------------------------------
+
+    def register_class_event(
+        self, fn: ClassEventFn, class_name: Optional[str] = None
+    ) -> None:
+        """Subscribe to create/destroy chains — all classes or one
+        (reference RegisterCommonClassEvent / AddClassCallBack)."""
+        if class_name is None:
+            self._class_event_subs.append(fn)
+        else:
+            self._class_event_by_class.setdefault(class_name, []).append(fn)
+
+    def register_property_event(
+        self, class_name: str, prop_name: str, fn: PropertyEventFn
+    ) -> None:
+        """Subscribe to a property's changes; called with changed row
+        indices after each tick (and synchronously on host writes)."""
+        self.store.spec(class_name).slot(prop_name)  # validate
+        # diff extraction depends only on diff_flags (static), so no
+        # recompilation is needed when subscribers change
+        self._prop_event_subs.setdefault((class_name, prop_name), []).append(fn)
+
+    def _fire_class_event(self, guid: Guid, class_name: str, ev: ObjectEvent) -> None:
+        for fn in self._class_event_by_class.get(class_name, ()):
+            fn(guid, class_name, ev)
+        for fn in self._class_event_subs:
+            fn(guid, class_name, ev)

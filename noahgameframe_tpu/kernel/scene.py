@@ -1,0 +1,218 @@
+"""Scene/group module: world partitioning + broadcast-set computation.
+
+Reference: NFCSceneAOIModule — scenes hold numbered groups; "AOI" is
+group-granular broadcast (NOT spatial): any Public-flagged change fans out
+to all Players in the same (scene, group); enter/leave choreography runs on
+GroupID/SceneID property changes with before/after hook vectors, and
+creating a group seeds its NPCs (NFCSceneAOIModule.cpp:82-160, 292-430,
+531-593; data model NFISceneAOIModule.h:36-145).
+
+TPU mapping: (SceneID, GroupID) are int32 columns in each class's i32 bank,
+so membership queries and broadcast sets are vectorised compares on device;
+`cell_key` (scene*MAX_GROUPS+group) is the partition key the sharding layer
+and the spatial-AOI ops both use.  Enter/leave stays host-side control
+plane (it is rare relative to the tick) and preserves the reference's hook
+ordering; true spatial neighbor queries live in ops/aoi.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.datatypes import Guid, Value
+from ..core.store import WorldState
+from .module import Module
+
+MAX_GROUPS_PER_SCENE = 1024  # fixes the cell_key encoding
+
+# hook signature: (guid, scene_id, group_id)
+SceneHookFn = Callable[[Guid, int, int], None]
+
+
+@dataclasses.dataclass
+class SeedSpec:
+    """An NPC seed planted in a scene: spawned into every new group
+    (reference scene Ini files list seed NPCs per scene)."""
+
+    elem_id: str
+    class_name: str
+    position: Tuple[float, float, float] = (0.0, 0.0, 0.0)
+    values: Optional[Dict[str, Value]] = None
+
+
+@dataclasses.dataclass
+class GroupInfo:
+    group_id: int
+    seeded: List[Guid] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class SceneInfo:
+    scene_id: int
+    seeds: List[SeedSpec] = dataclasses.field(default_factory=list)
+    groups: Dict[int, GroupInfo] = dataclasses.field(default_factory=dict)
+    next_group: int = 1
+    width: float = 512.0  # world extent, used by spatial AOI grids
+
+
+class SceneModule(Module):
+    name = "SceneModule"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.scenes: Dict[int, SceneInfo] = {}
+        # the reference's 10 callback vectors (NFCSceneAOIModule.h:95-105)
+        # collapse to 6 hook lists with identical ordering guarantees
+        self.before_enter_scene: List[SceneHookFn] = []
+        self.after_enter_scene: List[SceneHookFn] = []
+        self.before_leave_scene: List[SceneHookFn] = []
+        self.after_leave_scene: List[SceneHookFn] = []
+        self.on_swap_group: List[SceneHookFn] = []
+        self.on_group_created: List[Callable[[int, int], None]] = []
+
+    # -- scene / group management ------------------------------------------
+
+    def create_scene(
+        self, scene_id: int, seeds: Sequence[SeedSpec] = (), width: float = 512.0
+    ) -> SceneInfo:
+        if scene_id in self.scenes:
+            raise ValueError(f"scene {scene_id} already exists")
+        info = SceneInfo(scene_id=scene_id, seeds=list(seeds), width=width)
+        self.scenes[scene_id] = info
+        # group 0 always exists: the scene's "lobby" (reference creates
+        # group 0 implicitly; GroupID 0 broadcasts scene-wide)
+        info.groups[0] = GroupInfo(0)
+        return info
+
+    def request_group(self, scene_id: int, seed_npcs: bool = True) -> int:
+        """Allocate a fresh group in a scene and seed its NPCs (reference
+        RequestGroupScene)."""
+        info = self.scenes[scene_id]
+        gid = info.next_group
+        info.next_group += 1
+        if gid >= MAX_GROUPS_PER_SCENE:
+            raise RuntimeError(f"scene {scene_id} group ids exhausted")
+        group = GroupInfo(gid)
+        info.groups[gid] = group
+        if seed_npcs:
+            for seed in info.seeds:
+                g = self.kernel.create_from_element(
+                    seed.class_name,
+                    seed.elem_id,
+                    overrides={**(seed.values or {}), "Position": seed.position},
+                    scene=scene_id,
+                    group=gid,
+                )
+                group.seeded.append(g)
+        for fn in self.on_group_created:
+            fn(scene_id, gid)
+        return gid
+
+    def release_group(self, scene_id: int, group_id: int) -> int:
+        """Destroy a group and everything in it; returns destroyed count
+        (reference ReleaseGroupScene)."""
+        info = self.scenes[scene_id]
+        info.groups.pop(group_id, None)
+        n = 0
+        for class_name in self.kernel.store.class_order:
+            for guid in self.objects_in_group(scene_id, group_id, class_name):
+                self.kernel.destroy_object(guid)
+                n += 1
+        return n
+
+    # -- enter / leave choreography ----------------------------------------
+
+    def enter_scene(self, guid: Guid, scene_id: int, group_id: int) -> None:
+        """Full enter pipeline with before/after hooks on both sides
+        (reference RequestEnterScene + OnGroupEvent/OnSceneEvent)."""
+        if scene_id not in self.scenes:
+            raise KeyError(f"scene {scene_id} does not exist")
+        if group_id not in self.scenes[scene_id].groups:
+            raise KeyError(f"group {group_id} does not exist in scene {scene_id}")
+        k = self.kernel
+        old_scene = int(k.get_property(guid, "SceneID"))
+        old_group = int(k.get_property(guid, "GroupID"))
+        if old_scene == scene_id and old_group == group_id:
+            return
+        for fn in self.before_leave_scene:
+            fn(guid, old_scene, old_group)
+        for fn in self.before_enter_scene:
+            fn(guid, scene_id, group_id)
+        k.set_property(guid, "GroupID", 0)  # leave old group first
+        k.set_property(guid, "SceneID", scene_id)
+        k.set_property(guid, "GroupID", group_id)
+        for fn in self.after_leave_scene:
+            fn(guid, old_scene, old_group)
+        for fn in self.after_enter_scene:
+            fn(guid, scene_id, group_id)
+        if old_scene == scene_id:
+            for fn in self.on_swap_group:
+                fn(guid, scene_id, group_id)
+
+    # -- membership queries -------------------------------------------------
+
+    def _member_rows(self, scene_id: int, group_id: Optional[int], class_name: str) -> np.ndarray:
+        k = self.kernel
+        state = k.state
+        spec = k.store.spec(class_name)
+        if not (spec.has_property("SceneID") and spec.has_property("GroupID")):
+            return np.asarray([], np.int64)
+        cs = state.classes[class_name]
+        scene_col = np.asarray(cs.i32[:, spec.slots["SceneID"].col])
+        alive = np.asarray(cs.alive)
+        m = alive & (scene_col == scene_id)
+        if group_id is not None:
+            group_col = np.asarray(cs.i32[:, spec.slots["GroupID"].col])
+            m &= group_col == group_id
+        return np.flatnonzero(m)
+
+    def objects_in_group(
+        self, scene_id: int, group_id: int, class_name: str
+    ) -> List[Guid]:
+        """GetGroupObjectList equivalent."""
+        host = self.kernel.store._hosts[class_name]
+        return [
+            host.row_guid[int(r)]
+            for r in self._member_rows(scene_id, group_id, class_name)
+            if host.row_guid[int(r)] is not None
+        ]
+
+    def objects_in_scene(self, scene_id: int, class_name: str) -> List[Guid]:
+        host = self.kernel.store._hosts[class_name]
+        return [
+            host.row_guid[int(r)]
+            for r in self._member_rows(scene_id, None, class_name)
+            if host.row_guid[int(r)] is not None
+        ]
+
+    def broadcast_targets(
+        self, guid: Guid, public: bool, player_class: str = "Player"
+    ) -> List[Guid]:
+        """GetBroadCastObject: Public changes go to every player in the
+        same (scene, group) — GroupID 0 means scene-wide — Private changes
+        go to self only (if self is a player)
+        (NFCSceneAOIModule.cpp:531-593)."""
+        k = self.kernel
+        class_name, _ = k.store.row_of(guid)
+        if not public:
+            return [guid] if class_name == player_class else []
+        scene = int(k.get_property(guid, "SceneID"))
+        group = int(k.get_property(guid, "GroupID"))
+        if group == 0:
+            return self.objects_in_scene(scene, player_class)
+        return self.objects_in_group(scene, group, player_class)
+
+    # -- device views --------------------------------------------------------
+
+    def cell_key(self, state: WorldState, class_name: str) -> jnp.ndarray:
+        """[C] int32 partition key = scene*MAX_GROUPS+group; the unit of
+        broadcast, sharding and AOI locality."""
+        spec = self.kernel.store.spec(class_name)
+        cs = state.classes[class_name]
+        scene = cs.i32[:, spec.slots["SceneID"].col]
+        group = cs.i32[:, spec.slots["GroupID"].col]
+        return scene * MAX_GROUPS_PER_SCENE + group

@@ -1,0 +1,142 @@
+"""Schedule module: vectorised heartbeats.
+
+The reference walks guid -> name -> timer maps every tick and fires
+`DoHeartBeatEvent` when now > next (NFCScheduleModule.cpp:49-110) — O(live
+timers) of pointer chasing on the host.  Here every class has a fixed set of
+*timer slots* (registered before the world is built); per-entity timer state
+is four [C, T] arrays in ClassState.timers, and firing is one fused compare
+on device:
+
+    fired = active & alive & (tick >= next_fire)
+
+Handlers are device phases that read `ctx.fired(class_name, timer_name)`
+— a [C] bool column — instead of receiving one callback per object.
+Host-side per-object callbacks remain available via the event module
+(subscribe to the timer's event id) for control-plane consumers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.datatypes import Guid
+from ..core.store import ClassState, TimerState, WorldState, with_class
+from .module import Module
+
+
+class ScheduleModule(Module):
+    name = "ScheduleModule"
+
+    def __init__(self, dt: float = 1.0 / 30.0) -> None:
+        super().__init__()
+        self.dt = float(dt)
+        # (class_name -> timer_name -> slot index); frozen at build time
+        self._slots: Dict[str, Dict[str, int]] = {}
+        self._frozen = False
+
+    # -- registration (before kernel.build) ---------------------------------
+
+    def register_timer(self, class_name: str, timer_name: str) -> int:
+        """Declare a timer slot on a class.  Must happen before the world is
+        built — slot count fixes the [C, T] timer array shapes."""
+        if self._frozen:
+            raise RuntimeError("timer registration is closed once the world is built")
+        slots = self._slots.setdefault(class_name, {})
+        if timer_name in slots:
+            return slots[timer_name]
+        slots[timer_name] = len(slots)
+        return slots[timer_name]
+
+    def freeze(self) -> Dict[str, int]:
+        """Close registration; returns class -> slot count for StoreConfig."""
+        self._frozen = True
+        return {c: len(s) for c, s in self._slots.items()}
+
+    def slot(self, class_name: str, timer_name: str) -> int:
+        return self._slots[class_name][timer_name]
+
+    def timer_names(self, class_name: str) -> List[str]:
+        return list(self._slots.get(class_name, ()))
+
+    def ticks_of(self, seconds: float) -> int:
+        return max(1, int(round(float(seconds) / self.dt)))
+
+    # -- per-entity timer control (host, functional) ------------------------
+
+    def set_timer(
+        self,
+        state: WorldState,
+        store,
+        guid: Guid,
+        timer_name: str,
+        interval_s: float,
+        count: int = -1,
+        start_delay_s: Optional[float] = None,
+    ) -> WorldState:
+        """Arm a timer on one entity: fire every interval_s, `count` times
+        (-1 = forever), first firing after start_delay_s (defaults to one
+        interval) — AddHeartBeat semantics."""
+        class_name, row = store.row_of(guid)
+        return self.set_timer_rows(
+            state, class_name, np.asarray([row]), timer_name, interval_s, count, start_delay_s
+        )
+
+    def set_timer_rows(
+        self,
+        state: WorldState,
+        class_name: str,
+        rows: np.ndarray,
+        timer_name: str,
+        interval_s: float,
+        count: int = -1,
+        start_delay_s: Optional[float] = None,
+    ) -> WorldState:
+        slot = self.slot(class_name, timer_name)
+        interval = self.ticks_of(interval_s)
+        delay = interval if start_delay_s is None else self.ticks_of(start_delay_s)
+        cs = state.classes[class_name]
+        t = cs.timers
+        now = state.tick
+        t = TimerState(
+            next_fire=t.next_fire.at[rows, slot].set(now + delay),
+            interval=t.interval.at[rows, slot].set(interval),
+            remain=t.remain.at[rows, slot].set(count),
+            active=t.active.at[rows, slot].set(True),
+        )
+        return with_class(state, class_name, cs.replace(timers=t))
+
+    def cancel_timer(self, state: WorldState, store, guid: Guid, timer_name: str) -> WorldState:
+        class_name, row = store.row_of(guid)
+        slot = self.slot(class_name, timer_name)
+        cs = state.classes[class_name]
+        t = cs.timers
+        t = t.replace(active=t.active.at[row, slot].set(False))
+        return with_class(state, class_name, cs.replace(timers=t))
+
+    # -- device step (composed into the jitted tick by the kernel) ----------
+
+    def advance_class(
+        self, cs: ClassState, tick: jnp.ndarray
+    ) -> Tuple[ClassState, jnp.ndarray]:
+        """One schedule step for one class: returns (new_cs, fired[C, T]).
+
+        fired timers advance next_fire by interval; finite timers count
+        down and deactivate at zero.  Dead rows never fire."""
+        t = cs.timers
+        if t.active.shape[1] == 0:
+            return cs, t.active
+        due = t.active & (tick >= t.next_fire) & cs.alive[:, None]
+        next_fire = jnp.where(due, t.next_fire + t.interval, t.next_fire)
+        remain = jnp.where(due & (t.remain > 0), t.remain - 1, t.remain)
+        active = t.active & ~(due & (remain == 0))
+        return (
+            cs.replace(
+                timers=TimerState(
+                    next_fire=next_fire, interval=t.interval, remain=remain, active=active
+                )
+            ),
+            due,
+        )

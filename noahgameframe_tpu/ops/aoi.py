@@ -1,0 +1,154 @@
+"""Spatial AOI ops: uniform-grid neighbor queries with static shapes.
+
+The reference's "AOI" is group-granular broadcast; its spatial layer
+(2D-grid neighbor scan, BASELINE config 3) is rebuilt here TPU-first: a
+bucketed uniform grid with *static* shapes — `[n_cells, K]` entity slots —
+built by one sort + rank + scatter, queried by dense 3x3-stencil gathers.
+No dynamic shapes, no host loops: everything jits, vmaps and shard_maps.
+
+Design notes for TPU:
+- argsort + searchsorted-rank is XLA-native and O(N log N); the grid build
+  is one scatter with `mode="drop"` for bucket overflow (overflowing
+  entities simply miss the grid this tick — bounded error, never OOB).
+- queries gather fixed 9*K candidates per entity and mask by distance and
+  partition key, so the whole pipeline fuses into a handful of kernels.
+- K (bucket capacity) trades recall vs FLOPs; pick K ≥ expected max
+  entities/cell.  `grid_overflow` reports dropped counts for monitoring.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# 3x3 neighborhood stencil (dy, dx)
+_STENCIL = [(-1, -1), (-1, 0), (-1, 1), (0, -1), (0, 0), (0, 1), (1, -1), (1, 0), (1, 1)]
+
+
+class Grid(NamedTuple):
+    """Bucketed uniform grid: slots[c, k] = entity row or -1."""
+
+    slots: jnp.ndarray  # int32 [n_cells + 1, K]; last cell = overflow/dead
+    counts: jnp.ndarray  # int32 [n_cells + 1] true occupancy (may exceed K)
+    width: int  # cells per row (static)
+    cell_size: float  # world units per cell (static)
+
+
+def cell_of(pos: jnp.ndarray, cell_size: float, width: int) -> jnp.ndarray:
+    """[N, 2+] positions -> [N] row-major cell ids, clipped to the grid."""
+    cx = jnp.clip(jnp.floor(pos[:, 0] / cell_size).astype(jnp.int32), 0, width - 1)
+    cy = jnp.clip(jnp.floor(pos[:, 1] / cell_size).astype(jnp.int32), 0, width - 1)
+    return cy * width + cx
+
+
+def build_grid(
+    pos: jnp.ndarray,
+    active: jnp.ndarray,
+    cell_size: float,
+    width: int,
+    bucket: int,
+) -> Grid:
+    """Build the grid over `active` entities.  [N,2+] pos, [N] bool."""
+    n = pos.shape[0]
+    n_cells = width * width
+    cell = cell_of(pos, cell_size, width)
+    key = jnp.where(active, cell, n_cells)  # inactive -> overflow cell
+    order = jnp.argsort(key)
+    sorted_key = key[order]
+    # rank of each sorted element within its cell run
+    start = jnp.searchsorted(sorted_key, sorted_key, side="left")
+    rank = jnp.arange(n, dtype=jnp.int32) - start.astype(jnp.int32)
+    flat_slot = sorted_key * bucket + jnp.minimum(rank, bucket - 1)
+    # overflow (rank >= bucket) and dead entities scatter out of bounds -> dropped
+    oob = (n_cells + 1) * bucket
+    flat_slot = jnp.where((rank < bucket) & (sorted_key < n_cells), flat_slot, oob)
+    slots = (
+        jnp.full(((n_cells + 1) * bucket,), -1, jnp.int32)
+        .at[flat_slot]
+        .set(order.astype(jnp.int32), mode="drop")
+        .reshape(n_cells + 1, bucket)
+    )
+    counts = jnp.zeros((n_cells + 1,), jnp.int32).at[key].add(1, mode="drop")
+    return Grid(slots=slots, counts=counts, width=width, cell_size=cell_size)
+
+
+def grid_overflow(grid: Grid) -> jnp.ndarray:
+    """Total entities dropped by bucket overflow this build (monitoring)."""
+    bucket = grid.slots.shape[1]
+    return jnp.sum(jnp.maximum(grid.counts[:-1] - bucket, 0))
+
+
+def neighbor_candidates(query_cell: jnp.ndarray, grid: Grid) -> jnp.ndarray:
+    """[Q] query cell ids -> [Q, 9*K] candidate entity rows (-1 padded),
+    gathered from the 3x3 stencil around each query cell."""
+    w = grid.width
+    n_cells = w * w
+    cx = query_cell % w
+    cy = query_cell // w
+    cand = []
+    for dy, dx in _STENCIL:
+        nx, ny = cx + dx, cy + dy
+        valid = (nx >= 0) & (nx < w) & (ny >= 0) & (ny < w)
+        ncell = jnp.where(valid, ny * w + nx, n_cells)  # overflow cell is all -1
+        cand.append(grid.slots[ncell])  # [Q, K]
+    return jnp.concatenate(cand, axis=-1)
+
+
+def neighbor_mask(
+    pos: jnp.ndarray,
+    query_pos: jnp.ndarray,
+    cand: jnp.ndarray,
+    radius: float,
+    partition: Optional[jnp.ndarray] = None,
+    query_partition: Optional[jnp.ndarray] = None,
+    exclude_self: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """[Q, 9K] bool: candidate within `radius` of the query point, same
+    partition (scene*groups+group cell key), not self."""
+    safe = jnp.maximum(cand, 0)
+    d = query_pos[:, None, :2] - pos[safe][:, :, :2]
+    within = jnp.sum(d * d, axis=-1) <= radius * radius
+    m = within & (cand >= 0)
+    if partition is not None and query_partition is not None:
+        m &= partition[safe] == query_partition[:, None]
+    if exclude_self is not None:
+        m &= cand != exclude_self[:, None]
+    return m
+
+
+def neighbor_counts(
+    pos: jnp.ndarray,
+    active: jnp.ndarray,
+    radius: float,
+    cell_size: float,
+    width: int,
+    bucket: int,
+    partition: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """[N] number of active neighbors within radius of each entity — the
+    500k-entity AOI scan of BASELINE config 3 in one fused pipeline."""
+    grid = build_grid(pos, active, cell_size, width, bucket)
+    qcell = cell_of(pos, cell_size, width)
+    cand = neighbor_candidates(qcell, grid)
+    m = neighbor_mask(
+        pos,
+        pos,
+        cand,
+        radius,
+        partition=partition,
+        query_partition=partition,
+        exclude_self=jnp.arange(pos.shape[0], dtype=jnp.int32),
+    )
+    return jnp.sum(m & active[:, None], axis=-1, dtype=jnp.int32)
+
+
+def gather_reduce(
+    values: jnp.ndarray, cand: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Sum `values[cand]` over masked candidates: the scatter-free damage
+    accumulation primitive (victims PULL from an attacker grid instead of
+    attackers scattering — no collisions, fully parallel)."""
+    safe = jnp.maximum(cand, 0)
+    return jnp.sum(jnp.where(mask, values[safe], 0), axis=-1)

@@ -2,9 +2,21 @@ import os
 
 # Tests run on a virtual 8-device CPU mesh so sharding paths are exercised
 # without TPU hardware (the bench runs on the real chip instead).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+#
+# The container's sitecustomize registers the tunnelled-TPU ("axon") JAX
+# backend at interpreter startup and force-updates jax_platforms to
+# "axon,cpu" — overriding any JAX_PLATFORMS env setting.  Left alone, every
+# test run claims the single TPU through the tunnel and dispatches each tiny
+# test op over it (minutes-slow, and concurrent runs deadlock on the claim).
+# jax is already imported by that hook, so override its config directly.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", "tests must run on the CPU mesh"

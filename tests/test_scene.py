@@ -1,0 +1,139 @@
+"""Scene/group module: partitioning, enter/leave choreography, broadcast
+sets, NPC seeding (reference NFCSceneAOIModule behaviors)."""
+
+import numpy as np
+import pytest
+
+from noahgameframe_tpu.core import StoreConfig
+from noahgameframe_tpu.kernel import Kernel, Plugin, PluginManager
+from noahgameframe_tpu.kernel.scene import MAX_GROUPS_PER_SCENE, SceneModule, SeedSpec
+
+from fixtures import base_registry
+
+
+def build_pm():
+    pm = PluginManager()
+    kernel = Kernel(
+        base_registry(),
+        StoreConfig(default_capacity=256),
+        dt=1.0,
+        class_names=["IObject", "Player", "NPC"],
+    )
+    scene = SceneModule()
+    pm.register_plugin(Plugin("ScenePlugin", [kernel, scene]))
+    return pm, kernel, scene
+
+
+def setup_world(with_seeds=False):
+    pm, kernel, scene = build_pm()
+    pm.start()
+    seeds = []
+    if with_seeds:
+        kernel.elements.add_element(
+            "NPC", "Goblin", {"HP": 120, "MAXHP": 120, "HPREGEN": 3}
+        )
+        seeds = [SeedSpec("Goblin", "NPC", position=(3.0, 4.0, 0.0))]
+    scene.create_scene(1, seeds=seeds)
+    scene.create_scene(2)
+    return pm, kernel, scene
+
+
+def test_group_allocation_and_seeding():
+    pm, kernel, scene = setup_world(with_seeds=True)
+    gid = scene.request_group(1)
+    assert gid == 1
+    npcs = scene.objects_in_group(1, gid, "NPC")
+    assert len(npcs) == 1
+    npc = npcs[0]
+    assert kernel.get_property(npc, "ConfigID") == "Goblin"
+    assert kernel.get_property(npc, "HP") == 120
+    assert kernel.get_property(npc, "Position") == (3.0, 4.0, 0.0)
+    # a second group gets its own seeds
+    gid2 = scene.request_group(1)
+    assert len(scene.objects_in_group(1, gid2, "NPC")) == 1
+    assert len(scene.objects_in_scene(1, "NPC")) == 2
+
+
+def test_enter_scene_hooks_order_and_membership():
+    pm, kernel, scene = setup_world()
+    gid = scene.request_group(1)
+    calls = []
+    scene.before_enter_scene.append(lambda g, s, gr: calls.append(("be", s, gr)))
+    scene.after_enter_scene.append(lambda g, s, gr: calls.append(("ae", s, gr)))
+    scene.before_leave_scene.append(lambda g, s, gr: calls.append(("bl", s, gr)))
+    scene.after_leave_scene.append(lambda g, s, gr: calls.append(("al", s, gr)))
+    p = kernel.create_object("Player", {"Name": "alice"})
+    scene.enter_scene(p, 1, gid)
+    assert calls == [("bl", 0, 0), ("be", 1, gid), ("al", 0, 0), ("ae", 1, gid)]
+    assert scene.objects_in_group(1, gid, "Player") == [p]
+    assert kernel.get_property(p, "SceneID") == 1
+    # move to scene 2 group 0
+    calls.clear()
+    scene.enter_scene(p, 2, 0)
+    assert scene.objects_in_group(1, gid, "Player") == []
+    assert scene.objects_in_scene(2, "Player") == [p]
+    assert calls[0] == ("bl", 1, gid)
+
+
+def test_swap_group_within_scene_fires_swap_hook():
+    pm, kernel, scene = setup_world()
+    g1, g2 = scene.request_group(1), scene.request_group(1)
+    swaps = []
+    scene.on_swap_group.append(lambda g, s, gr: swaps.append((s, gr)))
+    p = kernel.create_object("Player")
+    scene.enter_scene(p, 1, g1)
+    scene.enter_scene(p, 1, g2)
+    assert swaps == [(1, g2)]
+
+
+def test_broadcast_targets_public_vs_private():
+    pm, kernel, scene = setup_world()
+    gid = scene.request_group(1)
+    p1 = kernel.create_object("Player")
+    p2 = kernel.create_object("Player")
+    p3 = kernel.create_object("Player")
+    npc = kernel.create_object("NPC", scene=1, group=gid)
+    scene.enter_scene(p1, 1, gid)
+    scene.enter_scene(p2, 1, gid)
+    scene.enter_scene(p3, 2, 0)
+    # public change on the NPC reaches the two players in its cell
+    targets = scene.broadcast_targets(npc, public=True)
+    assert sorted(map(str, targets)) == sorted(map(str, [p1, p2]))
+    # private change on an NPC reaches nobody; on a player reaches self
+    assert scene.broadcast_targets(npc, public=False) == []
+    assert scene.broadcast_targets(p1, public=False) == [p1]
+    # group 0 broadcasts scene-wide
+    p4 = kernel.create_object("Player")
+    scene.enter_scene(p4, 1, 0)
+    targets = scene.broadcast_targets(p4, public=True)
+    assert sorted(map(str, targets)) == sorted(map(str, [p1, p2, p4]))
+
+
+def test_release_group_destroys_members():
+    pm, kernel, scene = setup_world(with_seeds=True)
+    gid = scene.request_group(1)
+    p = kernel.create_object("Player")
+    scene.enter_scene(p, 1, gid)
+    n = scene.release_group(1, gid)
+    assert n == 2  # seeded NPC + player
+    assert kernel.store.live_count("NPC") == 0
+    assert kernel.store.live_count("Player") == 0
+
+
+def test_cell_key_encoding():
+    pm, kernel, scene = setup_world()
+    gid = scene.request_group(1)
+    p = kernel.create_object("Player")
+    scene.enter_scene(p, 1, gid)
+    key = np.asarray(scene.cell_key(kernel.state, "Player"))
+    _, row = kernel.store.row_of(p)
+    assert key[row] == 1 * MAX_GROUPS_PER_SCENE + gid
+
+
+def test_enter_unknown_scene_rejected():
+    pm, kernel, scene = setup_world()
+    p = kernel.create_object("Player")
+    with pytest.raises(KeyError):
+        scene.enter_scene(p, 99, 0)
+    with pytest.raises(KeyError):
+        scene.enter_scene(p, 1, 42)
