@@ -1,0 +1,76 @@
+"""Benchmark entry point: entities ticked per second on one chip.
+
+Runs the BASELINE config-2/4 style workload — N NPCs random-walking,
+regenerating, and resolving AoE combat through the grid-AOI pipeline —
+as the fully-fused device tick (`Kernel.run_device`), and prints ONE JSON
+line:
+
+    {"metric": "entities_ticked_per_sec_per_chip", "value": ..., "unit":
+     "entities*ticks/s", "vs_baseline": ...}
+
+`vs_baseline` is value / (1M entities * 30 Hz), i.e. 1.0 == the north-star
+"1M NPCs at 30 Hz on one chip's share of a v4-8" (BASELINE.json).  The
+reference itself publishes no numbers (BASELINE.md): its design point is
+5000 entities/process at <=1 kHz host loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+NORTH_STAR_RATE = 1_000_000 * 30  # entity-ticks/sec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--entities", type=int, default=200_000)
+    ap.add_argument("--ticks", type=int, default=90)
+    ap.add_argument("--no-combat", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    from noahgameframe_tpu.game import build_benchmark_world
+
+    n = args.entities
+    world = build_benchmark_world(n, combat=not args.no_combat, seed=42)
+    k = world.kernel
+
+    # compile + warm up the fused loop with the SAME trip count (run_device
+    # caches per n; a different warmup n would leave compile time in the
+    # timed region)
+    k.run_device(args.ticks)
+    jax.block_until_ready(k.state.classes["NPC"].i32)
+
+    t0 = time.perf_counter()
+    k.run_device(args.ticks)
+    jax.block_until_ready(k.state.classes["NPC"].i32)
+    dt = time.perf_counter() - t0
+
+    ticks_per_s = args.ticks / dt
+    rate = n * ticks_per_s
+    print(
+        json.dumps(
+            {
+                "metric": "entities_ticked_per_sec_per_chip",
+                "value": round(rate, 1),
+                "unit": "entity-ticks/s",
+                "vs_baseline": round(rate / NORTH_STAR_RATE, 4),
+                "detail": {
+                    "entities": n,
+                    "ticks": args.ticks,
+                    "elapsed_s": round(dt, 4),
+                    "ticks_per_s": round(ticks_per_s, 2),
+                    "tick_ms": round(1000 * dt / args.ticks, 3),
+                    "device": str(jax.devices()[0]),
+                    "combat": not args.no_combat,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -551,6 +551,54 @@ class EntityStore:
         used = np.asarray(rec.used[row])
         return [int(i) for i in np.flatnonzero(used & (col == enc))]
 
+    def record_write_rows(
+        self,
+        state: WorldState,
+        class_name: str,
+        rows: np.ndarray,
+        record_name: str,
+        rec_row: int,
+        col_values: Dict[str, Sequence[Value]],
+        mark_used: bool = True,
+    ) -> WorldState:
+        """Bulk write one record row (`rec_row`) across many entities: for
+        each tag, col_values[tag][i] lands in entity rows[i].  One scatter
+        per touched bank — the batch path stat seeding and equip systems
+        use (host-loop-free counterpart of NFCRecord::SetInt per object)."""
+        rs = self._rec(class_name, record_name)
+        n = len(rows)
+        staged: Dict[Bank, np.ndarray] = {}
+        shapes = {
+            Bank.I32: (n, rs.n_i32),
+            Bank.F32: (n, rs.n_f32),
+            Bank.VEC: (n, rs.n_vec, 3),
+        }
+        touched: Dict[Bank, List[int]] = {Bank.I32: [], Bank.F32: [], Bank.VEC: []}
+        for tag, vals in col_values.items():
+            slot = rs.cols[tag]
+            if slot.bank not in staged:
+                staged[slot.bank] = np.zeros(shapes[slot.bank], np.float32 if slot.bank != Bank.I32 else np.int32)
+            enc = [self.encode(slot.col_def.type, v) for v in vals]
+            staged[slot.bank][:, slot.col] = np.asarray(enc)
+            touched[slot.bank].append(slot.col)
+        cs = state.classes[class_name]
+        rec = cs.records[record_name]
+        i32, f32, vec = rec.i32, rec.f32, rec.vec
+        if touched[Bank.I32]:
+            cols = np.asarray(touched[Bank.I32])
+            i32 = i32.at[rows[:, None], rec_row, cols[None, :]].set(staged[Bank.I32][:, cols])
+        if touched[Bank.F32]:
+            cols = np.asarray(touched[Bank.F32])
+            f32 = f32.at[rows[:, None], rec_row, cols[None, :]].set(staged[Bank.F32][:, cols])
+        if touched[Bank.VEC]:
+            cols = np.asarray(touched[Bank.VEC])
+            vec = vec.at[rows[:, None], rec_row, cols[None, :]].set(staged[Bank.VEC][:, cols])
+        used = rec.used.at[rows, rec_row].set(True) if mark_used else rec.used
+        rec = rec.replace(i32=i32, f32=f32, vec=vec, used=used)
+        return with_class(
+            state, class_name, cs.replace(records={**cs.records, record_name: rec})
+        )
+
     def _record_write(
         self,
         state: WorldState,

@@ -1,0 +1,33 @@
+"""Gameplay layer: the reference's NFGameServerPlugin/NFGameLogicPlugin
+capabilities rebuilt as batched device phases + host control-plane APIs."""
+
+from .combat import ATTACK_TIMER, CombatModule, SkillModule
+from .defines import COMM_PROPERTY_RECORD, GameEvent, NpcType, PropertyGroup, STAT_NAMES
+from .level import LevelModule
+from .movement import MovementModule
+from .property_config import PropertyConfigModule
+from .regen import REGEN_TIMER, RegenModule
+from .schema import standard_registry
+from .stats import PropertyModule
+from .world import GameWorld, WorldConfig, build_benchmark_world
+
+__all__ = [
+    "ATTACK_TIMER",
+    "COMM_PROPERTY_RECORD",
+    "CombatModule",
+    "GameEvent",
+    "GameWorld",
+    "LevelModule",
+    "MovementModule",
+    "NpcType",
+    "PropertyConfigModule",
+    "PropertyGroup",
+    "PropertyModule",
+    "REGEN_TIMER",
+    "RegenModule",
+    "STAT_NAMES",
+    "SkillModule",
+    "WorldConfig",
+    "build_benchmark_world",
+    "standard_registry",
+]

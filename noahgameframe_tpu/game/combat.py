@@ -1,0 +1,219 @@
+"""Combat: skill use, AoE damage resolution, NPC death & respawn.
+
+Reference behavior being matched:
+- NFCSkillModule::OnUseSkill — validate the skill element, damage the
+  target (HP-10 floor 0) and stamp LastAttacker
+  (NFCSkillModule.cpp:74-160, resolution :133-139).
+- NFCNPCRefreshModule — watch HP; at <=0 fire ON_OBJECT_BE_KILLED with the
+  LastAttacker and schedule a 5 s respawn heartbeat that restores the NPC
+  from its seed/config (NFCNPCRefreshModule.cpp:115-135 and
+  OnDeadDestroyHeart).
+
+TPU inversion (BASELINE config 4's 1M-entity AoE resolve): attackers whose
+`Attack` timer fired are binned into the uniform grid (ops/aoi.py); every
+entity then PULLS incoming damage from the 3x3-stencil candidates within
+the skill radius — a gather-reduce with zero scatter collisions — applies
+`max(sum_atk - def, 0)`, picks the strongest in-range attacker as
+LastAttacker, and the death sweep emits one batched BE_KILLED event and
+arms device-side respawn (HP restored after `respawn_s`, keeping the row;
+destroy-on-death is the host path via the event).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.datatypes import Guid
+from ..core.store import HANDLE_ROW_BITS, WorldState, with_class
+from ..kernel.module import Module
+from ..ops.aoi import build_grid, cell_of, neighbor_candidates
+from .defines import GameEvent
+
+ATTACK_TIMER = "Attack"
+
+
+class CombatModule(Module):
+    """Batched AoE combat + death/respawn for one fighter class."""
+
+    name = "CombatModule"
+
+    def __init__(
+        self,
+        class_name: str = "NPC",
+        extent: float = 512.0,
+        radius: float = 4.0,
+        cell_size: Optional[float] = None,
+        bucket: int = 8,
+        respawn_s: float = 5.0,
+        attack_period_s: float = 1.0,
+        order: int = 30,
+        emit_events: bool = True,
+    ):
+        super().__init__()
+        self.class_name = class_name
+        self.extent = float(extent)
+        self.radius = float(radius)
+        self.cell_size = float(cell_size if cell_size is not None else max(radius, 1.0))
+        self.width = max(1, int(self.extent / self.cell_size))
+        self.bucket = int(bucket)
+        self.respawn_s = float(respawn_s)
+        self.attack_period_s = float(attack_period_s)
+        self.emit_events = emit_events
+        self.add_phase("aoe", self._combat_phase, order=order)
+        self.add_phase("death", self._death_phase, order=order + 5)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def init(self) -> None:
+        # timer slots must exist before the world is built
+        self.kernel.schedule.register_timer(self.class_name, ATTACK_TIMER)
+
+    def arm_all(self) -> None:
+        """Arm the attack heartbeat on every live row (benchmark seeding)."""
+        import numpy as np
+
+        k = self.kernel
+        cs = k.state.classes[self.class_name]
+        rows = np.flatnonzero(np.asarray(cs.alive))
+        k.state = k.schedule.set_timer_rows(
+            k.state, self.class_name, rows, ATTACK_TIMER, self.attack_period_s
+        )
+
+    # -- device phases -------------------------------------------------------
+
+    def _combat_phase(self, state: WorldState, ctx) -> WorldState:
+        cname = self.class_name
+        store = ctx.store
+        if cname not in store.class_index:
+            return state
+        spec = store.spec(cname)
+        cs = state.classes[cname]
+        pos = cs.vec[:, spec.slot("Position").col, :2]
+        hp_col = spec.slot("HP").col
+        hp = cs.i32[:, hp_col]
+        atk = cs.i32[:, spec.slot("ATK_VALUE").col]
+        deff = cs.i32[:, spec.slot("DEF_VALUE").col]
+        camp = (
+            cs.i32[:, spec.slot("Camp").col]
+            if spec.has_property("Camp")
+            else jnp.zeros_like(hp)
+        )
+
+        attacking = ctx.fired(cname, ATTACK_TIMER) & cs.alive & (hp > 0)
+        if spec.has_property("SKILL_GATE"):
+            attacking &= cs.i32[:, spec.slot("SKILL_GATE").col] == 0
+
+        # combat is (scene, group)-scoped like every broadcast in the
+        # reference (NFCSceneAOIModule::GetBroadCastObject) — entities at
+        # overlapping coordinates in different cells never interact
+        from ..kernel.scene import MAX_GROUPS_PER_SCENE
+
+        cell_key = (
+            cs.i32[:, spec.slot("SceneID").col] * MAX_GROUPS_PER_SCENE
+            + cs.i32[:, spec.slot("GroupID").col]
+        )
+
+        grid = build_grid(pos, attacking, self.cell_size, self.width, self.bucket)
+        qcell = cell_of(pos, self.cell_size, self.width)
+        cand = neighbor_candidates(qcell, grid)  # [C, 9K]
+        safe = jnp.maximum(cand, 0)
+        d = pos[:, None, :] - pos[safe]
+        in_range = jnp.sum(d * d, axis=-1) <= self.radius * self.radius
+        valid = (
+            (cand >= 0)
+            & in_range
+            & (cand != jnp.arange(pos.shape[0], dtype=jnp.int32)[:, None])
+            & (camp[safe] != camp[:, None])  # no friendly fire
+            & (cell_key[safe] == cell_key[:, None])  # same (scene, group)
+            & cs.alive[:, None]
+            & (hp[:, None] > 0)
+        )
+        incoming = jnp.sum(jnp.where(valid, atk[safe], 0), axis=-1)
+        dmg = jnp.maximum(incoming - deff, 0)
+        dmg = jnp.where(incoming > 0, jnp.maximum(dmg, 1), 0)  # a hit always chips
+        new_hp = jnp.maximum(hp - dmg, 0)
+        i32 = cs.i32.at[:, hp_col].set(new_hp)
+
+        if spec.has_property("LastAttacker"):
+            # strongest in-range attacker, packed as an object handle
+            cls_idx = store.class_index[cname]
+            masked_atk = jnp.where(valid, atk[safe], -1)
+            best = jnp.argmax(masked_atk, axis=-1)
+            best_row = jnp.take_along_axis(cand, best[:, None], axis=-1)[:, 0]
+            handle = (cls_idx << HANDLE_ROW_BITS) | jnp.maximum(best_row, 0)
+            la_col = spec.slot("LastAttacker").col
+            hit = incoming > 0
+            i32 = i32.at[:, la_col].set(
+                jnp.where(hit, handle, i32[:, la_col])
+            )
+        return with_class(state, cname, cs.replace(i32=i32))
+
+    def _death_phase(self, state: WorldState, ctx) -> WorldState:
+        cname = self.class_name
+        store = ctx.store
+        if cname not in store.class_index:
+            return state
+        spec = store.spec(cname)
+        if not spec.has_property("DeadTick"):
+            return state
+        cs = state.classes[cname]
+        hp_col = spec.slot("HP").col
+        dead_col = spec.slot("DeadTick").col
+        hp = cs.i32[:, hp_col]
+        dead_tick = cs.i32[:, dead_col]
+
+        just_died = cs.alive & (hp <= 0) & (dead_tick == 0)
+        if self.emit_events:
+            params = {}
+            if spec.has_property("LastAttacker"):
+                params["killer"] = cs.i32[:, spec.slot("LastAttacker").col]
+            ctx.emit(int(GameEvent.ON_OBJECT_BE_KILLED), cname, just_died, **params)
+        # DeadTick stores tick+1 so tick 0 deaths are distinguishable from 0
+        i32 = cs.i32.at[:, dead_col].set(
+            jnp.where(just_died, ctx.tick + 1, dead_tick)
+        )
+
+        respawn_ticks = max(1, int(round(self.respawn_s / ctx.dt)))
+        due = (dead_tick > 0) & (ctx.tick + 1 - dead_tick >= respawn_ticks) & cs.alive
+        if spec.has_property("MAXHP"):
+            maxhp = cs.i32[:, spec.slot("MAXHP").col]
+            # no MAXHP stat -> nothing to restore -> stay dead (otherwise
+            # DeadTick would clear with HP still 0 and BE_KILLED would
+            # re-fire every respawn interval forever)
+            due &= maxhp > 0
+            i32 = i32.at[:, hp_col].set(jnp.where(due, maxhp, i32[:, hp_col]))
+        else:
+            due &= False
+        i32 = i32.at[:, dead_col].set(jnp.where(due, 0, i32[:, dead_col]))
+        if self.emit_events:
+            ctx.emit(int(GameEvent.ON_NPC_RESPAWN), cname, due)
+        return with_class(state, cname, cs.replace(i32=i32))
+
+
+class SkillModule(Module):
+    """Host-side targeted skill use (reference NFCSkillModule parity)."""
+
+    name = "SkillModule"
+
+    def __init__(self, skill_damage: int = 10):
+        super().__init__()
+        self.skill_damage = int(skill_damage)
+
+    def use_skill(self, attacker: Guid, skill_id: str, target: Guid) -> bool:
+        """Validate the skill element, damage the target by 10 (floor 0),
+        stamp LastAttacker (NFCSkillModule.cpp:113-139)."""
+        k = self.kernel
+        if not k.elements.exists(skill_id):
+            return False
+        if target not in k.store.guid_map:
+            return False
+        tclass, _ = k.store.row_of(target)
+        cur = int(k.get_property(target, "HP"))
+        if cur <= 0:
+            return False
+        if k.store.spec(tclass).has_property("LastAttacker"):
+            k.set_property(target, "LastAttacker", attacker)
+        k.set_property(target, "HP", max(cur - self.skill_damage, 0))
+        return True

@@ -1,0 +1,82 @@
+"""Shared gameplay constants: stat names, property groups, event ids.
+
+Reference equivalents: the NPG_* property-group enum
+(NFIPropertyModule.h:19-29), the CommPropertyValue stat column set
+(_Out/NFDataCfg/Struct/Class/Player.xml, Record Id="CommPropertyValue"),
+and the NFEventDefine event-id space (NFComm/NFPluginModule/NFEventDefine.h).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class PropertyGroup(enum.IntEnum):
+    """Stat contribution groups; the final stat is the sum over groups
+    (reference NFIPropertyModule.h:19-29, summed in
+    NFCPropertyModule::OnRecordPropertyEvent)."""
+
+    JOBLEVEL = 0
+    EFFECTVALUE = 1
+    REBIRTH_ADD = 2
+    EQUIP = 3
+    EQUIP_AWARD = 4
+    STATIC_BUFF = 5
+    RUNTIME_BUFF = 6
+    ALL = 7  # row count, not a row
+
+
+# the combat/consumable stat block every fighter carries — column order of
+# the CommPropertyValue record (Player.xml CommPropertyValue cols)
+STAT_NAMES = (
+    "SUCKBLOOD",
+    "REFLECTDAMAGE",
+    "CRITICAL",
+    "MAXHP",
+    "MAXMP",
+    "MAXSP",
+    "HPREGEN",
+    "SPREGEN",
+    "MPREGEN",
+    "ATK_VALUE",
+    "DEF_VALUE",
+    "MOVE_SPEED",
+    "ATK_SPEED",
+    "ATK_FIRE",
+    "ATK_LIGHT",
+    "ATK_WIND",
+    "ATK_ICE",
+    "ATK_POISON",
+    "DEF_FIRE",
+    "DEF_LIGHT",
+    "DEF_WIND",
+    "DEF_ICE",
+    "DEF_POISON",
+    "DIZZY_GATE",
+    "MOVE_GATE",
+    "SKILL_GATE",
+    "PHYSICAL_GATE",
+    "MAGIC_GATE",
+    "BUFF_GATE",
+)
+
+COMM_PROPERTY_RECORD = "CommPropertyValue"
+
+
+class NpcType(enum.IntEnum):
+    """NFMsg::ENPCType (NFMsgBase.proto)."""
+
+    NORMAL = 0
+    HERO = 1
+    TURRET = 2
+    FUNC = 3
+
+
+class GameEvent(enum.IntEnum):
+    """Framework gameplay event ids (reference NFEventDefine.h names; the
+    numeric values are ours — the reference never pins them on the wire)."""
+
+    ON_OBJECT_BE_KILLED = 1
+    ON_LEVEL_UP = 2
+    ON_NPC_RESPAWN = 3
+    ON_USE_SKILL_RESULT = 4

@@ -1,0 +1,161 @@
+"""Level module: exp accrual and level-ups, host API + batched device phase.
+
+Reference: NFCLevelModule::AddExp loops `while remain >= 0: level++` reading
+MAXEXP from the property config each iteration (NFCLevelModule.cpp:38-69),
+and the Level property-callback chain then refreshes base stats and refills
+HP/MP/SP (NFCPropertyModule::OnObjectLevelEvent).
+
+TPU inversion: exp awarded during a tick accumulates in an `EXP` delta
+column; the level phase converts *total accumulated exp* to (level, rem)
+via one searchsorted over precomputed cumulative thresholds
+(PropertyConfigModule.level_from_total_exp) — no loops, any number of
+level-ups per tick.  On level change it rewrites the NPG_JOBLEVEL stat row
+from the (job, level) table and refills HP/MP/SP, then emits ON_LEVEL_UP
+with the old/new levels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.datatypes import Guid
+from ..core.store import WorldState, with_class
+from ..kernel.module import Module
+from .defines import GameEvent, PropertyGroup
+from .property_config import PropertyConfigModule
+from .stats import PropertyModule
+
+
+class LevelModule(Module):
+    name = "LevelModule"
+
+    def __init__(
+        self,
+        config: PropertyConfigModule,
+        properties: Optional[PropertyModule] = None,
+        class_name: str = "Player",
+        order: int = 50,
+        emit_events: bool = True,
+    ):
+        super().__init__()
+        self.config = config
+        self.properties = properties
+        self.class_name = class_name
+        self.emit_events = emit_events
+        # device phase BEFORE the stat recompute (order 60) so a level-up's
+        # new JOBLEVEL row lands in the same tick's final stats
+        self.add_phase("level", self._level_phase, order=order)
+
+    # -- device phase --------------------------------------------------------
+
+    def _level_phase(self, state: WorldState, ctx) -> WorldState:
+        cname = self.class_name
+        store = ctx.store
+        if cname not in store.class_index:
+            return state
+        spec = store.spec(cname)
+        cs = state.classes[cname]
+        job = store.column(state, cname, "Job") if spec.has_property("Job") else None
+        if job is None or self.config.cum_exp is None:
+            return state
+        level_col = spec.slot("Level").col
+        exp_col = spec.slot("EXP").col
+        maxexp_col = spec.slot("MAXEXP").col
+
+        old_level = cs.i32[:, level_col]
+        exp_in_level = cs.i32[:, exp_col]
+        # total accumulated exp = cum threshold of current level + exp within
+        j = jnp.clip(job, 0, self.config.n_jobs - 1)
+        cum = self.config.cum_exp[j]  # [C, L+1]
+        lvl_idx = jnp.clip(old_level, 0, self.config.max_level)
+        base = jnp.take_along_axis(cum, lvl_idx[:, None], axis=1)[:, 0]
+        total = base + exp_in_level
+        new_level, rem = self.config.level_from_total_exp(job, total)
+        # a job with no MAXEXP configured at the current level cannot level
+        # (host add_exp guards max_exp > 0 the same way; an all-zero table
+        # would otherwise searchsorted everyone straight to max_level)
+        cur_maxexp = jnp.take_along_axis(
+            self.config.max_exp[j], lvl_idx[:, None], axis=1
+        )[:, 0]
+        can_level = cs.alive & (cur_maxexp > 0)
+        new_level = jnp.where(can_level, jnp.maximum(new_level, old_level), old_level)
+        rem = jnp.where(can_level, rem, exp_in_level)
+
+        leveled = new_level != old_level
+        i32 = cs.i32.at[:, level_col].set(new_level)
+        i32 = i32.at[:, exp_col].set(rem)
+        new_maxexp = jnp.take_along_axis(
+            self.config.max_exp[j], jnp.clip(new_level, 0, self.config.max_level)[:, None], axis=1
+        )[:, 0]
+        i32 = i32.at[:, maxexp_col].set(new_maxexp)
+        cs = cs.replace(i32=i32)
+
+        # refresh NPG_JOBLEVEL stat row for leveled entities + refill
+        # HP/MP/SP from the NEW MAXes (reference FullHPMP/FullSP); the stat
+        # recompute phase (order 60) folds the row into MAXHP etc, so we
+        # compute the new maxima here from the group sums directly.
+        from .defines import COMM_PROPERTY_RECORD, STAT_NAMES  # local to avoid cycle
+
+        if COMM_PROPERTY_RECORD in spec.records:
+            rs = spec.records[COMM_PROPERTY_RECORD]
+            rec = cs.records[COMM_PROPERTY_RECORD]
+            base_stats = self.config.base_stats_for(job, new_level)  # [C, S]
+            rec_cols = jnp.asarray([rs.cols[n].col for n in STAT_NAMES])
+            job_row = rec.i32[:, int(PropertyGroup.JOBLEVEL), :]
+            updated = job_row.at[:, rec_cols].set(base_stats)
+            new_rec_i32 = rec.i32.at[:, int(PropertyGroup.JOBLEVEL), :].set(
+                jnp.where(leveled[:, None], updated, job_row)
+            )
+            rec = rec.replace(i32=new_rec_i32)
+            totals = jnp.sum(new_rec_i32, axis=1, dtype=jnp.int32)  # [C, S_rec]
+            i32 = cs.i32
+            for cur, mx in (("HP", "MAXHP"), ("MP", "MAXMP"), ("SP", "MAXSP")):
+                if not spec.has_property(cur):
+                    continue
+                mcol = totals[:, rs.cols[mx].col]
+                ccol = spec.slot(cur).col
+                i32 = i32.at[:, ccol].set(
+                    jnp.where(leveled & (mcol > 0), mcol, i32[:, ccol])
+                )
+            cs = cs.replace(
+                i32=i32, records={**cs.records, COMM_PROPERTY_RECORD: rec}
+            )
+
+        if self.emit_events:
+            ctx.emit(
+                int(GameEvent.ON_LEVEL_UP),
+                cname,
+                leveled & cs.alive,
+                old_level=old_level,
+                new_level=new_level,
+            )
+        return with_class(state, cname, cs)
+
+    # -- host API (reference NFILevelModule) --------------------------------
+
+    def add_exp(self, guid: Guid, exp: int) -> int:
+        """Host-side immediate AddExp with full level-up semantics; the
+        device phase does the same thing batch-wise at the next tick."""
+        k = self.kernel
+        job = int(k.get_property(guid, "Job"))
+        level = int(k.get_property(guid, "Level"))
+        cur = int(k.get_property(guid, "EXP")) + int(exp)
+        max_exp = self.config.calculate_base_value(job, level, "MAXEXP")
+        leveled = False
+        while max_exp > 0 and cur >= max_exp and level < self.config.max_level:
+            cur -= max_exp
+            level += 1
+            leveled = True
+            max_exp = self.config.calculate_base_value(job, level, "MAXEXP")
+        k.set_property(guid, "EXP", cur)
+        if leveled:
+            k.set_property(guid, "Level", level)
+            k.set_property(guid, "MAXEXP", max_exp)
+            if self.properties is not None:
+                self.properties.refresh_base_property(guid, self.config)
+                self.properties.recompute_now(guid)
+                self.properties.full_hp_mp(guid)
+                self.properties.full_sp(guid)
+        return level

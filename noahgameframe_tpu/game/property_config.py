@@ -1,0 +1,138 @@
+"""Per-(job, level) base-stat tables, compiled to a dense device array.
+
+Reference: NFCPropertyConfigModule loads InitProperty elements into a
+job -> level -> effect-element map and answers CalculateBaseValue(job,
+level, stat) with a per-call element lookup
+(NFCPropertyConfigModule.cpp:37-88).  Here the whole table compiles once
+into `table[n_jobs, n_levels, n_stats]` int32 on device, so RefreshBase-
+Property for a million players is one gather — and level-from-exp is a
+searchsorted over precomputed cumulative MAXEXP thresholds instead of the
+reference's while-loop (NFCLevelModule.cpp:38-69).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.element import ElementStore
+from ..kernel.module import Module
+from .defines import STAT_NAMES
+
+
+class PropertyConfigModule(Module):
+    name = "PropertyConfigModule"
+
+    def __init__(self, n_jobs: int = 4, max_level: int = 100):
+        super().__init__()
+        self.n_jobs = int(n_jobs)
+        self.max_level = int(max_level)
+        # host-side staging; frozen to device arrays on ready_execute
+        self._base = np.zeros((n_jobs, max_level + 1, len(STAT_NAMES)), np.int32)
+        self._max_exp = np.zeros((n_jobs, max_level + 1), np.int32)
+        self.table: Optional[jnp.ndarray] = None  # [J, L+1, S] int32
+        self.max_exp: Optional[jnp.ndarray] = None  # [J, L+1] int32
+        self.cum_exp: Optional[jnp.ndarray] = None  # [J, L+1] int64
+
+    # -- table construction --------------------------------------------------
+
+    def set_level_config(
+        self, job: int, level: int, stats: Dict[str, int], max_exp: int = 0
+    ) -> None:
+        for k, v in stats.items():
+            self._base[job, level, STAT_NAMES.index(k)] = int(v)
+        self._max_exp[job, level] = int(max_exp)
+        self.table = None
+
+    def load_elements(self, elements: ElementStore) -> int:
+        """Ingest InitProperty elements: each names a (Job, Level) cell and
+        an EffectData element holding the stat values (reference
+        NFCPropertyConfigModule::Load)."""
+        n = 0
+        for eid in elements.ids_of_class("InitProperty"):
+            e = elements.element(eid)
+            job = int(e.values.get("Job", 0))
+            level = int(e.values.get("Level", 0))
+            if not (0 <= job < self.n_jobs and 0 <= level <= self.max_level):
+                continue
+            stats: Dict[str, int] = {}
+            ref = str(e.values.get("EffectData", "") or "")
+            if ref and elements.exists(ref):
+                ev = elements.element(ref).values
+                stats = {k: int(v) for k, v in ev.items() if k in STAT_NAMES}
+            self.set_level_config(
+                job, level, stats, max_exp=int(e.values.get("MAXEXP", 0))
+            )
+            n += 1
+        return n
+
+    def fill_linear(
+        self,
+        job: int,
+        base: Dict[str, int],
+        per_level: Dict[str, int],
+        max_exp_base: int = 100,
+        max_exp_per_level: int = 50,
+    ) -> None:
+        """Procedural table for tests/benchmarks: stat = base + lvl*slope."""
+        lv = np.arange(self.max_level + 1)
+        for k in STAT_NAMES:
+            b, s = int(base.get(k, 0)), int(per_level.get(k, 0))
+            self._base[job, :, STAT_NAMES.index(k)] = b + lv * s
+        self._max_exp[job] = max_exp_base + lv * max_exp_per_level
+        self.table = None
+
+    def freeze(self) -> None:
+        """Push the tables to device.  cum_exp[j, L] = total exp needed to
+        REACH level L from level 0 — level(total_exp) is one searchsorted.
+
+        The compiled tick closes over these arrays as constants, so
+        re-freezing after the world compiled must invalidate the jit cache
+        — otherwise phases keep the old table silently."""
+        self.table = jnp.asarray(self._base)
+        self.max_exp = jnp.asarray(self._max_exp)
+        cum = np.zeros((self.n_jobs, self.max_level + 1), np.int64)
+        cum[:, 1:] = np.cumsum(self._max_exp[:, :-1].astype(np.int64), axis=1)
+        self.cum_exp = jnp.asarray(cum)
+        if self.kernel is not None:
+            self.kernel.invalidate()
+
+    def ready_execute(self) -> None:
+        if self.table is None:
+            self.freeze()
+
+    # -- host-side queries (reference-parity API) ---------------------------
+
+    def calculate_base_value(self, job: int, level: int, stat: str) -> int:
+        if stat == "MAXEXP":
+            return int(self._max_exp[job, level])
+        return int(self._base[job, level, STAT_NAMES.index(stat)])
+
+    def legal_level(self, job: int, level: int) -> bool:
+        return 0 <= job < self.n_jobs and 0 <= level <= self.max_level
+
+    # -- device-side queries -------------------------------------------------
+
+    def base_stats_for(self, job: jnp.ndarray, level: jnp.ndarray) -> jnp.ndarray:
+        """[C] job, [C] level -> [C, S] base stats (one fused gather)."""
+        j = jnp.clip(job, 0, self.n_jobs - 1)
+        l = jnp.clip(level, 0, self.max_level)
+        return self.table[j, l]
+
+    def level_from_total_exp(
+        self, job: jnp.ndarray, total_exp: jnp.ndarray
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Total accumulated exp -> (level, exp-within-level).  Replaces the
+        reference's per-player level-up while-loop with a vectorised
+        searchsorted per job row."""
+        j = jnp.clip(job, 0, self.n_jobs - 1)
+        te = total_exp
+
+        # searchsorted row-wise: level = number of thresholds <= total_exp, -1
+        thresholds = self.cum_exp[j]  # [C, L+1]
+        level = jnp.sum(thresholds <= te[:, None], axis=1).astype(jnp.int32) - 1
+        level = jnp.clip(level, 0, self.max_level)
+        rem = (te - jnp.take_along_axis(thresholds, level[:, None], axis=1)[:, 0]).astype(jnp.int32)
+        return level, rem

@@ -1,0 +1,198 @@
+"""The standard game schema: IObject / Player / NPC (+ scene classes).
+
+Mirrors the reference's generated class XMLs in capability, not layout:
+- IObject root with identity/scene columns (LogicClass.xml root class)
+- Player with the full combat-stat block, progression, wallet, and the
+  CommPropertyValue stat-group record (Class/Player.xml)
+- NPC with the combat-stat block, seed/refresh fields, LastAttacker, and
+  movement targets (Class/NPC.xml)
+
+The property set is intentionally the reference's so the persistence,
+broadcast-flag and stat-recompute semantics can be tested 1:1; games define
+their own classes the same way (see tests/fixtures.py for a minimal one).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from ..core.schema import ClassDef, ClassRegistry, prop, record
+from .defines import COMM_PROPERTY_RECORD, PropertyGroup, STAT_NAMES
+
+
+def _stat_props():
+    """The shared fighter stat block (Public+Private like the reference)."""
+    return [prop(n, "int", public=True, private=True) for n in STAT_NAMES]
+
+
+def _comm_property_record():
+    """Per-group stat contributions; final stat = column sum over the group
+    rows (reference CommPropertyValue, Row=15 in the XML but only NPG_ALL=7
+    rows are ever used — we size it exactly)."""
+    return record(
+        COMM_PROPERTY_RECORD,
+        int(PropertyGroup.ALL),
+        [(n, "int") for n in STAT_NAMES],
+        public=True,
+        private=True,
+    )
+
+
+def standard_registry(extra: Optional[Iterable[ClassDef]] = None) -> ClassRegistry:
+    reg = ClassRegistry()
+    reg.define(
+        ClassDef(
+            name="IObject",
+            properties=[
+                prop("ID", "string", private=True),
+                prop("ClassName", "string", private=True),
+                prop("SceneID", "int", private=True),
+                prop("GroupID", "int", private=True),
+                prop("ConfigID", "string", private=True),
+                prop("Position", "vector3", public=True, private=True, save=True, cache=True),
+                prop("Camp", "int", public=True, private=True),
+            ],
+        )
+    )
+    reg.define(
+        ClassDef(
+            name="Player",
+            parent="IObject",
+            properties=[
+                prop("Name", "string", public=True, private=True, save=True, cache=True),
+                prop("Sex", "int", public=True, private=True, save=True),
+                prop("Race", "int", public=True, private=True, save=True),
+                prop("Job", "int", public=True, private=True, save=True),
+                prop("Level", "int", public=True, private=True, save=True, cache=True),
+                prop("EXP", "int", private=True, save=True),
+                prop("VIPLevel", "int", public=True, private=True, save=True),
+                prop("VIPEXP", "int", private=True, save=True),
+                prop("HP", "int", public=True, private=True, save=True),
+                prop("MP", "int", public=True, private=True, save=True),
+                prop("SP", "int", public=True, private=True, save=True),
+                prop("Gold", "int", private=True, save=True, upload=True),
+                prop("Money", "int", private=True, save=True, upload=True),
+                prop("Account", "string", private=True),
+                prop("ConnectKey", "string", private=True),
+                prop("MAXEXP", "int", public=True, private=True),
+                prop("OnlineCount", "int", private=True, save=True),
+                prop("TotalTime", "int", private=True, save=True),
+                prop("GMLevel", "int", private=True, save=True),
+                prop("GameID", "int", private=True),
+                prop("GateID", "int", private=True),
+                prop("GuildID", "object", public=True, private=True, save=True),
+                prop("TeamID", "object", public=True, private=True),
+                prop("FirstTarget", "object", public=True, private=True),
+                prop("MoveTo", "vector2"),
+            ]
+            + _stat_props(),
+            records=[
+                _comm_property_record(),
+                record(
+                    "PlayerHero",
+                    16,
+                    [
+                        ("GUID", "object"),
+                        ("ConfigID", "string"),
+                        ("Level", "int"),
+                        ("Exp", "int"),
+                        ("Star", "int"),
+                    ],
+                    private=True,
+                    save=True,
+                ),
+                record(
+                    "BagItemList",
+                    64,
+                    [
+                        ("ConfigID", "string"),
+                        ("ItemCount", "int"),
+                        ("Bound", "int"),
+                        ("ExpiredType", "int"),
+                        ("Date", "int"),
+                    ],
+                    private=True,
+                    save=True,
+                ),
+                record(
+                    "BagEquipList",
+                    32,
+                    [
+                        ("GUID", "object"),
+                        ("WearGUID", "object"),
+                        ("ConfigID", "string"),
+                        ("ExpiredType", "int"),
+                        ("Date", "int"),
+                        ("SlotCount", "int"),
+                    ],
+                    private=True,
+                    save=True,
+                ),
+                record(
+                    "TaskList",
+                    32,
+                    [
+                        ("TaskID", "string"),
+                        ("TaskStatus", "int"),
+                        ("Process", "int"),
+                    ],
+                    private=True,
+                    save=True,
+                ),
+            ],
+        )
+    )
+    reg.define(
+        ClassDef(
+            name="NPC",
+            parent="IObject",
+            properties=[
+                prop("SeedID", "string"),
+                prop("HP", "int", public=True, private=True, save=True),
+                prop("MP", "int", public=True, private=True, save=True),
+                prop("SP", "int", public=True, private=True, save=True),
+                prop("EXP", "int", public=True, private=True, save=True),
+                prop("Gold", "int", public=True, private=True, save=True),
+                prop("NPCType", "int"),
+                prop("MasterID", "object", private=True, save=True),
+                prop("LastAttacker", "object"),
+                prop("EffectData", "string"),
+                prop("AtkDis", "float"),
+                prop("MoveType", "int"),
+                prop("TargetPos", "vector2"),
+                prop("DeadTick", "int"),
+            ]
+            + _stat_props(),
+            records=[_comm_property_record()],
+        )
+    )
+    # per-(job,level) base-stat table rows (reference InitProperty class,
+    # consumed by NFCPropertyConfigModule::Load)
+    reg.define(
+        ClassDef(
+            name="InitProperty",
+            parent="IObject",
+            properties=[
+                prop("Job", "int"),
+                prop("Level", "int"),
+                prop("EffectData", "string"),
+                prop("MAXEXP", "int"),
+            ],
+        )
+    )
+    reg.define(
+        ClassDef(
+            name="Scene",
+            parent="IObject",
+            properties=[
+                prop("SceneName", "string"),
+                prop("MaxGroup", "int"),
+                prop("Width", "int"),
+                prop("SceneType", "int"),  # normal vs clone (NFISceneProcessModule.h:15-20)
+            ],
+        )
+    )
+    if extra:
+        for cd in extra:
+            reg.define(cd)
+    return reg

@@ -1,0 +1,218 @@
+"""Property module: stat groups, derived-stat recompute, HP/MP/SP/wallet.
+
+Reference: NFCPropertyModule keeps per-player stat *contributions* in the
+CommPropertyValue record (one row per NPG_* group) and, on every record
+write, folds the column sum into the final property of the same name
+(NFCPropertyModule.cpp:128-150); level changes refresh the NPG_JOBLEVEL row
+from the per-(job,level) config and refill HP/MP/SP
+(OnObjectLevelEvent/RefreshBaseProperty, :117-125, 193-240).
+
+TPU inversion: contributions live in the record bank `[C, NPG_ALL, S]`
+already, so the whole class's recompute is ONE sum over the group axis and
+ONE scatter into the property columns, fused into the tick.  The recompute
+phase runs unconditionally each tick (cheaper than tracking dirtiness at
+[C] granularity — it's a [C, 7, 29] int32 reduce, trivially MXU/VPU
+friendly); host mutators mirror the reference's imperative API for
+control-plane use.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.datatypes import Guid
+from ..core.store import WorldState, with_class
+from ..kernel.kernel import ObjectEvent
+from ..kernel.module import Module
+from .defines import COMM_PROPERTY_RECORD, PropertyGroup, STAT_NAMES
+from .property_config import PropertyConfigModule
+
+STAT_ORDER = {n: i for i, n in enumerate(STAT_NAMES)}
+
+
+class PropertyModule(Module):
+    """Derived-stat recompute for every class that carries the
+    CommPropertyValue record (Player, NPC)."""
+
+    name = "PropertyModule"
+
+    def __init__(self, classes: Sequence[str] = ("Player", "NPC"), order: int = 60):
+        super().__init__()
+        self.classes = tuple(classes)
+        self._stat_cols: Dict[str, np.ndarray] = {}  # class -> i32 prop cols per stat
+        self._rec_cols: Dict[str, np.ndarray] = {}  # class -> record i32 cols per stat
+        self.add_phase("recompute", self._recompute_phase, order=order)
+
+    # -- wiring --------------------------------------------------------------
+
+    def after_init(self) -> None:
+        store = self.kernel.store
+        for cname in self.classes:
+            if cname not in store.class_index:
+                continue
+            spec = store.spec(cname)
+            if COMM_PROPERTY_RECORD not in spec.records:
+                continue
+            rs = spec.records[COMM_PROPERTY_RECORD]
+            self._stat_cols[cname] = np.asarray(
+                [spec.slot(n).col for n in STAT_NAMES], np.int32
+            )
+            self._rec_cols[cname] = np.asarray(
+                [rs.cols[n].col for n in STAT_NAMES], np.int32
+            )
+
+    # -- the device phase ----------------------------------------------------
+
+    def _recompute_phase(self, state: WorldState, ctx) -> WorldState:
+        for cname, prop_cols in self._stat_cols.items():
+            cs = state.classes[cname]
+            rec = cs.records[COMM_PROPERTY_RECORD]
+            # [C, NPG_ALL, S_rec] -> [C, S_rec]; unused rows are zero-filled
+            # so summing all rows is exact
+            totals = jnp.sum(rec.i32, axis=1, dtype=jnp.int32)
+            rec_cols = self._rec_cols[cname]
+            cs = cs.replace(i32=cs.i32.at[:, prop_cols].set(totals[:, rec_cols]))
+            state = with_class(state, cname, cs)
+        return state
+
+    # -- group mutation (host control plane, reference API parity) ----------
+
+    def set_group_value(
+        self, guid: Guid, stat: str, group: PropertyGroup, value: int
+    ) -> None:
+        k = self.kernel
+        k.state = k.store.record_set(
+            k.state, guid, COMM_PROPERTY_RECORD, int(group), stat, int(value)
+        )
+
+    def get_group_value(self, guid: Guid, stat: str, group: PropertyGroup) -> int:
+        k = self.kernel
+        return int(
+            k.store.record_get(k.state, guid, COMM_PROPERTY_RECORD, int(group), stat)
+        )
+
+    def add_group_value(
+        self, guid: Guid, stat: str, group: PropertyGroup, value: int
+    ) -> None:
+        self.set_group_value(
+            guid, stat, group, self.get_group_value(guid, stat, group) + int(value)
+        )
+
+    def sub_group_value(
+        self, guid: Guid, stat: str, group: PropertyGroup, value: int
+    ) -> None:
+        self.add_group_value(guid, stat, group, -int(value))
+
+    def refresh_base_property(self, guid: Guid, config: PropertyConfigModule) -> None:
+        """Write the (job, level) base-stat row into NPG_JOBLEVEL
+        (reference RefreshBaseProperty)."""
+        k = self.kernel
+        job = int(k.get_property(guid, "Job"))
+        level = int(k.get_property(guid, "Level"))
+        for stat in STAT_NAMES:
+            self.set_group_value(
+                guid,
+                stat,
+                PropertyGroup.JOBLEVEL,
+                config.calculate_base_value(job, level, stat),
+            )
+
+    def recompute_now(self, guid: Guid) -> None:
+        """Immediate host-side fold of the group sums into the final
+        properties, for callers that need read-after-write before the next
+        tick (the device phase keeps everyone consistent each frame)."""
+        k = self.kernel
+        cname, row = k.store.row_of(guid)
+        rec = k.state.classes[cname].records[COMM_PROPERTY_RECORD]
+        totals = np.asarray(jnp.sum(rec.i32[row], axis=0, dtype=jnp.int32))
+        for stat in STAT_NAMES:
+            rcol = self._rec_cols[cname][STAT_ORDER[stat]]
+            k.set_property(guid, stat, int(totals[rcol]))
+
+    # -- HP/MP/SP + wallet (reference NFIPropertyModule API) ----------------
+
+    def full_hp_mp(self, guid: Guid) -> None:
+        k = self.kernel
+        for cur, mx in (("HP", "MAXHP"), ("MP", "MAXMP")):
+            m = int(k.get_property(guid, mx))
+            if m > 0:
+                k.set_property(guid, cur, m)
+
+    def full_sp(self, guid: Guid) -> None:
+        k = self.kernel
+        m = int(k.get_property(guid, "MAXSP"))
+        if m > 0:
+            k.set_property(guid, "SP", m)
+
+    def _add(self, guid: Guid, prop: str, maxprop: Optional[str], value: int) -> bool:
+        if value <= 0:
+            return False
+        k = self.kernel
+        cur = int(k.get_property(guid, prop))
+        if maxprop is not None:
+            if cur <= 0:
+                return True  # reference AddHP no-ops on dead entities
+            cur = min(cur + value, int(k.get_property(guid, maxprop)))
+        else:
+            cur += value
+        k.set_property(guid, prop, cur)
+        return True
+
+    def _consume(self, guid: Guid, prop: str, value: int) -> bool:
+        k = self.kernel
+        cur = int(k.get_property(guid, prop))
+        if value <= 0 or cur < value:
+            return False
+        k.set_property(guid, prop, cur - value)
+        return True
+
+    def _enough(self, guid: Guid, prop: str, value: int) -> bool:
+        return int(self.kernel.get_property(guid, prop)) >= value > 0
+
+    def add_hp(self, g: Guid, v: int) -> bool:
+        return self._add(g, "HP", "MAXHP", int(v))
+
+    def consume_hp(self, g: Guid, v: int) -> bool:
+        return self._consume(g, "HP", int(v))
+
+    def enough_hp(self, g: Guid, v: int) -> bool:
+        return self._enough(g, "HP", int(v))
+
+    def add_mp(self, g: Guid, v: int) -> bool:
+        return self._add(g, "MP", "MAXMP", int(v))
+
+    def consume_mp(self, g: Guid, v: int) -> bool:
+        return self._consume(g, "MP", int(v))
+
+    def enough_mp(self, g: Guid, v: int) -> bool:
+        return self._enough(g, "MP", int(v))
+
+    def add_sp(self, g: Guid, v: int) -> bool:
+        return self._add(g, "SP", "MAXSP", int(v))
+
+    def consume_sp(self, g: Guid, v: int) -> bool:
+        return self._consume(g, "SP", int(v))
+
+    def enough_sp(self, g: Guid, v: int) -> bool:
+        return self._enough(g, "SP", int(v))
+
+    def add_money(self, g: Guid, v: int) -> bool:
+        return self._add(g, "Gold", None, int(v))
+
+    def consume_money(self, g: Guid, v: int) -> bool:
+        return self._consume(g, "Gold", int(v))
+
+    def enough_money(self, g: Guid, v: int) -> bool:
+        return self._enough(g, "Gold", int(v))
+
+    def add_diamond(self, g: Guid, v: int) -> bool:
+        return self._add(g, "Money", None, int(v))
+
+    def consume_diamond(self, g: Guid, v: int) -> bool:
+        return self._consume(g, "Money", int(v))
+
+    def enough_diamond(self, g: Guid, v: int) -> bool:
+        return self._enough(g, "Money", int(v))

@@ -1,0 +1,198 @@
+"""GameWorld: one-call assembly of the standard game stack.
+
+The reference assembles a Game server from Plugin.xml: Kernel + Config +
+GameServerPlugin (property/level/scene modules) + GameLogicPlugin
+(skill/NPC modules) loaded into one NFCPluginManager
+(_Out/Debug/Plugin.xml).  GameWorld is that composition as a library call,
+plus the benchmark scenario builders used by bench.py and the BASELINE
+configs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..core.schema import ClassRegistry
+from ..core.store import StoreConfig
+from ..kernel.kernel import Kernel
+from ..kernel.plugin import Plugin, PluginManager
+from ..kernel.scene import SceneModule
+from .combat import CombatModule, SkillModule
+from .defines import COMM_PROPERTY_RECORD, PropertyGroup, STAT_NAMES
+from .level import LevelModule
+from .movement import MovementModule
+from .property_config import PropertyConfigModule
+from .regen import RegenModule
+from .schema import standard_registry
+from .stats import PropertyModule
+
+
+@dataclasses.dataclass
+class WorldConfig:
+    npc_capacity: int = 1024
+    player_capacity: int = 64
+    extent: float = 512.0
+    dt: float = 1.0 / 30.0
+    seed: int = 0
+    aoe_radius: float = 4.0
+    aoi_bucket: int = 8
+    respawn_s: float = 5.0
+    attack_period_s: float = 1.0
+    regen_period_s: float = 1.0
+    combat: bool = True
+    movement: bool = True
+    regen: bool = True
+    diff_flags: tuple = ("public", "upload")
+
+
+class GameWorld:
+    """The assembled standard stack; `.pm` is the plugin manager."""
+
+    def __init__(self, config: Optional[WorldConfig] = None, registry: Optional[ClassRegistry] = None):
+        self.config = cfg = config or WorldConfig()
+        reg = registry or standard_registry()
+        self.kernel = Kernel(
+            reg,
+            StoreConfig(
+                default_capacity=64,
+                capacities={
+                    "NPC": cfg.npc_capacity,
+                    "Player": cfg.player_capacity,
+                    "IObject": 8,
+                    "InitProperty": 8,
+                    "Scene": 8,
+                },
+            ),
+            dt=cfg.dt,
+            seed=cfg.seed,
+            diff_flags=cfg.diff_flags,
+        )
+        self.scene = SceneModule()
+        self.property_config = PropertyConfigModule()
+        self.properties = PropertyModule()
+        self.level = LevelModule(self.property_config, self.properties)
+        self.skills = SkillModule()
+        modules = [self.kernel, self.scene, self.property_config, self.properties, self.level, self.skills]
+        self.movement = None
+        self.combat = None
+        self.regen = None
+        if cfg.movement:
+            self.movement = MovementModule(extent=cfg.extent)
+            modules.append(self.movement)
+        if cfg.combat:
+            self.combat = CombatModule(
+                extent=cfg.extent,
+                radius=cfg.aoe_radius,
+                bucket=cfg.aoi_bucket,
+                respawn_s=cfg.respawn_s,
+                attack_period_s=cfg.attack_period_s,
+            )
+            modules.append(self.combat)
+        if cfg.regen:
+            self.regen = RegenModule(period_s=cfg.regen_period_s)
+            modules.append(self.regen)
+
+        self._rng = np.random.default_rng(cfg.seed)
+        self.pm = PluginManager(app_name="game")
+        self.pm.register_plugin(Plugin("KernelPlugin", [self.kernel]))
+        self.pm.register_plugin(Plugin("ConfigPlugin", [self.property_config]))
+        self.pm.register_plugin(
+            Plugin("GameServerPlugin", [m for m in modules if m not in (self.kernel, self.property_config)])
+        )
+
+    def start(self) -> "GameWorld":
+        self.pm.start()
+        return self
+
+    # -- seeding --------------------------------------------------------------
+
+    def seed_npcs(
+        self,
+        n: int,
+        scene: int = 1,
+        group: int = 0,
+        hp: int = 100,
+        atk: int = 12,
+        deff: int = 3,
+        regen: int = 2,
+        move_speed: int = 30000,
+        camps: int = 2,
+        rng: Optional[np.random.Generator] = None,
+    ) -> None:
+        """Bulk-spawn n NPCs with randomized positions/camps — the NPC seed
+        spawning of scene groups (NFCSceneAOIModule RequestEnterScene) at
+        benchmark scale."""
+        # the world-owned generator advances across calls — two waves must
+        # not land on identical coordinates
+        r = rng or self._rng
+        ext = self.config.extent
+        pos = r.uniform(0.0, ext, (n, 3)).astype(np.float32)
+        pos[:, 2] = 0.0
+        k = self.kernel
+        values = {
+            "SceneID": np.full(n, scene, np.int64).tolist(),
+            "GroupID": np.full(n, group, np.int64).tolist(),
+            "Position": [tuple(p) for p in pos],
+            "TargetPos": [tuple(p[:2]) for p in r.uniform(0.0, ext, (n, 2)).astype(np.float32)],
+            "HP": [hp] * n,
+            "Camp": r.integers(0, camps, n).tolist(),
+        }
+        k.state, guids, rows = k.store.create_many(k.state, "NPC", n, values=values)
+        # combat stats go through the EFFECTVALUE group of the stat record —
+        # the recompute phase is the single source of truth for final stats
+        # (reference NPCs likewise get theirs from the EffectData config,
+        # NFCNPCRefreshModule.cpp:83-96)
+        k.state = k.store.record_write_rows(
+            k.state,
+            "NPC",
+            rows,
+            COMM_PROPERTY_RECORD,
+            int(PropertyGroup.EFFECTVALUE),
+            {
+                "MAXHP": [hp] * n,
+                "HPREGEN": [regen] * n,
+                "ATK_VALUE": [atk] * n,
+                "DEF_VALUE": [deff] * n,
+                "MOVE_SPEED": [move_speed] * n,
+            },
+        )
+        if self.combat is not None:
+            self.combat.arm_all()
+        if self.regen is not None:
+            self.regen.arm_all("NPC")
+
+    def tick(self):
+        self.pm.run_once()
+
+    def run(self, frames: int) -> None:
+        self.pm.run(frames)
+
+
+def build_benchmark_world(
+    n_npcs: int,
+    extent: Optional[float] = None,
+    combat: bool = True,
+    seed: int = 0,
+    attack_period_s: float = 1.0,
+) -> GameWorld:
+    """The staged BASELINE configs: density held at ~0.4 NPCs per world
+    unit² so AOI cost scales with N, not with density."""
+    if extent is None:
+        extent = max(64.0, float(np.sqrt(n_npcs / 0.4)))
+    cap = 1 << int(np.ceil(np.log2(max(n_npcs, 64))))
+    w = GameWorld(
+        WorldConfig(
+            npc_capacity=cap,
+            extent=extent,
+            combat=combat,
+            seed=seed,
+            attack_period_s=attack_period_s,
+        )
+    )
+    w.start()
+    w.scene.create_scene(1, width=extent)
+    w.seed_npcs(n_npcs)
+    return w

@@ -136,6 +136,8 @@ class Kernel(Module):
         # other module's so composition can't double-count them
         self._composed: List[Phase] = []
         self._jit_step = None
+        self._jit_run = None
+        self._jit_run_n: Optional[int] = None
         self._class_event_subs: List[ClassEventFn] = []
         self._class_event_by_class: Dict[str, List[ClassEventFn]] = {}
         self._prop_event_subs: Dict[Tuple[str, str], List[PropertyEventFn]] = {}
@@ -176,6 +178,7 @@ class Kernel(Module):
     def set_phases(self, phases: Sequence[Phase]) -> None:
         self._composed = sorted(phases, key=lambda p: p.order)
         self._jit_step = None
+        self._jit_run = None
 
     # -- the compiled tick --------------------------------------------------
 
@@ -236,6 +239,26 @@ class Kernel(Module):
         # static event metadata is captured on self at trace time; only the
         # traced arrays cross the jit boundary (dataclasses aren't pytrees)
         self._event_meta = [(e.event_id, e.class_name, tuple(e.params)) for e in ctx.emitted]
+        # ONE packed scalar vector per tick — the only thing the host ever
+        # synchronously fetches.  Anything else (masks, params, fired) is
+        # fetched lazily and only when this summary says there's something
+        # to see; over the TPU tunnel every fetch is a round trip, so this
+        # is the difference between 1 and O(classes+events) syncs per tick.
+        summary = jnp.concatenate(
+            [
+                jnp.stack([died_count[c] for c in self.store.class_order])
+                if self.store.class_order
+                else jnp.zeros((0,), jnp.int32),
+                jnp.stack([diff_count[c] for c in sorted(diff_count)])
+                if diff_count
+                else jnp.zeros((0,), jnp.int32),
+                jnp.stack(
+                    [jnp.sum(e.mask, dtype=jnp.int32) for e in ctx.emitted]
+                )
+                if ctx.emitted
+                else jnp.zeros((0,), jnp.int32),
+            ]
+        )
         out = {
             "fired": fired,
             "diff": diff,
@@ -243,12 +266,20 @@ class Kernel(Module):
             "died": died,
             "died_count": died_count,
             "events": [(e.mask, e.params) for e in ctx.emitted],
+            "summary": summary,
         }
         return state, out
 
     def compile(self) -> None:
         if self._jit_step is None:
             self._jit_step = jax.jit(self._trace_step, donate_argnums=0)
+
+    def invalidate(self) -> None:
+        """Force retrace of the compiled tick.  Call after changing
+        anything phases close over (config tables, phase lists) — traced
+        constants do NOT update on their own."""
+        self._jit_step = None
+        self._jit_run = None
 
     def tick(self) -> TickOutputs:
         """Advance the world one frame and fan out host-visible effects."""
@@ -268,17 +299,54 @@ class Kernel(Module):
                 )
             ],
         )
-        self._post_tick(out)
+        self._post_tick(out, np.asarray(raw["summary"]))
         return out
 
-    def _post_tick(self, out: TickOutputs) -> None:
+    def run_device(self, n: int) -> int:
+        """Advance n frames entirely on device (lax.fori_loop over the
+        step) with ZERO host syncs — the headless/benchmark fast path.
+
+        Per-tick host observation is skipped: device events, per-tick
+        diffs and fired masks are not delivered (XLA dead-code-eliminates
+        them); deaths are reconciled once at the end.  Use tick() when
+        host subscribers must see every frame."""
+        self.compile()
+        key = int(n)
+        if self._jit_run is None or self._jit_run_n != key:
+
+            def body(_, st):
+                st2, _out = self._trace_step(st)
+                return st2
+
+            self._jit_run = jax.jit(
+                lambda st: jax.lax.fori_loop(0, key, body, st), donate_argnums=0
+            )
+            self._jit_run_n = key
+        self.state = self._jit_run(self.state)
+        self.tick_count += key
+        freed = 0
+        for cname in self.store.class_order:
+            for g in self.store.reconcile_deaths(self.state, cname):
+                self._fire_class_event(g, cname, ObjectEvent.DESTROY)
+                freed += 1
+        return freed
+
+    def _post_tick(self, out: TickOutputs, summary: np.ndarray) -> None:
+        n_cls = len(self.store.class_order)
+        died_counts = summary[:n_cls]
+        diff_keys = sorted(out.diff_count)
+        diff_counts = dict(zip(diff_keys, summary[n_cls : n_cls + len(diff_keys)]))
+        event_counts = summary[n_cls + len(diff_keys) :]
         # device-emitted events FIRST — entities that died this tick must
         # still deliver their events (the reference fires events before
         # destroy), so guid identities are intact here
-        if out.events:
-            self.events.dispatch_device_events(out.events, self.store)
+        live_events = [
+            ev for ev, cnt in zip(out.events, event_counts) if cnt > 0
+        ]
+        if live_events:
+            self.events.dispatch_device_events(live_events, self.store)
         # deaths: reconcile host allocation + fire destroy events
-        for cname, cnt in out.died_count.items():
+        for cname, cnt in zip(self.store.class_order, died_counts):
             if int(cnt) == 0:
                 continue
             dead = self.store.reconcile_deaths(self.state, cname)
@@ -290,7 +358,7 @@ class Kernel(Module):
                 masks = out.diff.get(cname)
                 if not masks:
                     continue
-                if int(out.diff_count[cname]) == 0:
+                if int(diff_counts[cname]) == 0:
                     continue
                 slot = self.store.spec(cname).slot(pname)
                 bank_name = slot.bank.value

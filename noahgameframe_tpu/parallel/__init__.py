@@ -1,0 +1,19 @@
+"""Distributed execution: device meshes, sharded world tick, collectives.
+
+The reference's scale-out stack (consistent-hash player routing, scene/
+group partitioning, World-server cross-shard relay — SURVEY §2.4, §5) maps
+here to jax.sharding over ICI/DCN.
+"""
+
+from .mesh import SHARD_AXIS, make_mesh, replicated, row_sharding
+from .shard import ShardedKernel, shard_rows_by_cell, world_shardings
+
+__all__ = [
+    "SHARD_AXIS",
+    "ShardedKernel",
+    "make_mesh",
+    "replicated",
+    "row_sharding",
+    "shard_rows_by_cell",
+    "world_shardings",
+]

@@ -1,0 +1,45 @@
+"""Device mesh construction.
+
+The reference scales by multi-process sharding: players consistent-hash
+onto game servers, worlds partition into (scene, group) cells, and
+cross-shard traffic relays through the World server (SURVEY §5
+"long-context").  The TPU equivalent is a jax.sharding.Mesh: the entity
+axis of every class bank shards across devices ("shard" axis), and
+cross-shard effects ride XLA collectives over ICI instead of TCP relays.
+
+Multi-host: build the mesh over all addressable+remote devices via
+jax.distributed (the driver's dryrun uses a virtual CPU mesh; real pods
+use the same code path).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = SHARD_AXIS) -> Mesh:
+    """1-D mesh over the first n devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices, have {len(devs)} "
+                "(set XLA_FLAGS=--xla_force_host_platform_device_count=N for CPU)"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def row_sharding(mesh: Mesh, axis: str = SHARD_AXIS) -> NamedSharding:
+    """Shard the leading (entity/capacity) axis; replicate the rest."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,145 @@
+"""Sharded world: distribute the entity store over a device mesh.
+
+Strategy (SURVEY §7 step 5): every per-entity array in WorldState shards
+its leading capacity axis across the 1-D mesh; scalars (tick, rng)
+replicate.  The tick compiles once with `jax.jit` + sharding annotations
+and XLA inserts the collectives — the grid-AOI sort/gather pipeline
+becomes a global sort with all-to-alls over ICI, replacing the reference's
+World-server relay hop for cross-shard visibility
+(NFCWorldNet_ServerModule.cpp:600-830).
+
+Entities don't migrate between shards explicitly: a row's shard is fixed
+by its index, and *visibility* crosses shards through the collectives, so
+"cross-shard migration" is free (the reference must re-home the object and
+replay its state; here the row never moves, only the data flows).  For
+locality-tuned placement, `shard_rows_by_cell` allocates rows so that a
+(scene, group) cell lands on one shard.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.store import WorldState
+from ..kernel.kernel import Kernel
+from .mesh import SHARD_AXIS, make_mesh
+
+
+def world_shardings(state: WorldState, mesh: Mesh, axis: str = SHARD_AXIS):
+    """Pytree of NamedShardings matching WorldState: leading-axis sharding
+    for per-entity arrays, replication for scalars/keys."""
+    row = NamedSharding(mesh, PartitionSpec(axis))
+    rep = NamedSharding(mesh, PartitionSpec())
+    n_dev = mesh.devices.size
+
+    def pick(leaf):
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] % n_dev == 0 and leaf.shape[0] > 0:
+            return row
+        return rep
+
+    classes = jax.tree.map(pick, state.classes)
+    return state.replace(classes=classes, tick=rep, rng=rep)
+
+
+class ShardedKernel:
+    """Wraps a built Kernel to run its tick sharded over a mesh.
+
+    Usage:
+        k.build(...); sk = ShardedKernel(k, n_devices=8)
+        sk.place()          # move state onto the mesh
+        sk.tick()           # sharded single step (host observation intact)
+        sk.run_device(n)    # fused n-step loop, zero host syncs
+    """
+
+    def __init__(self, kernel: Kernel, n_devices: Optional[int] = None, mesh: Optional[Mesh] = None):
+        self.kernel = kernel
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        for cname in kernel.store.class_order:
+            cap = kernel.store.capacity(cname)
+            if cap % self.mesh.devices.size != 0:
+                raise ValueError(
+                    f"class {cname!r} capacity {cap} not divisible by "
+                    f"{self.mesh.devices.size} devices — pad StoreConfig.capacities"
+                )
+        self._jit_step = None
+        self._jit_run = None
+        self._jit_run_n = None
+
+    # -- placement -----------------------------------------------------------
+
+    def place(self) -> None:
+        shardings = world_shardings(self.kernel.state, self.mesh)
+        self.kernel.state = jax.device_put(self.kernel.state, shardings)
+
+    # -- compiled sharded step ----------------------------------------------
+
+    def _compile(self):
+        if self._jit_step is None:
+            shardings = world_shardings(self.kernel.state, self.mesh)
+            self._jit_step = jax.jit(
+                self.kernel._trace_step,
+                in_shardings=(shardings,),
+                out_shardings=(shardings, None),
+                donate_argnums=0,
+            )
+        return self._jit_step
+
+    def tick(self):
+        """One sharded step with full host observation (events, deaths,
+        diffs) — same semantics as Kernel.tick."""
+        import numpy as np
+
+        from ..kernel.kernel import DeviceEvent, TickOutputs
+
+        k = self.kernel
+        step = self._compile()
+        k.state, raw = step(k.state)
+        k.tick_count += 1
+        out = TickOutputs(
+            fired=raw["fired"],
+            diff=raw["diff"],
+            diff_count=raw["diff_count"],
+            died=raw["died"],
+            died_count=raw["died_count"],
+            events=[
+                DeviceEvent(eid, cname, mask, dict(params))
+                for (eid, cname, pnames), (mask, params) in zip(
+                    k._event_meta, raw["events"]
+                )
+            ],
+        )
+        k._post_tick(out, np.asarray(raw["summary"]))
+        return out
+
+    def run_device(self, n: int) -> None:
+        """Fused n-tick sharded loop (benchmark path)."""
+        key = int(n)
+        if self._jit_run is None or self._jit_run_n != key:
+            shardings = world_shardings(self.kernel.state, self.mesh)
+
+            def body(_, st):
+                st2, _out = self.kernel._trace_step(st)
+                return st2
+
+            self._jit_run = jax.jit(
+                lambda st: jax.lax.fori_loop(0, key, body, st),
+                in_shardings=(shardings,),
+                out_shardings=shardings,
+                donate_argnums=0,
+            )
+            self._jit_run_n = key
+        self.kernel.state = self._jit_run(self.kernel.state)
+        self.kernel.tick_count += key
+
+
+def shard_rows_by_cell(n: int, n_devices: int, cell: np.ndarray) -> np.ndarray:
+    """Allocation helper: order n new rows so entities of one (scene,group)
+    cell land contiguously, i.e. on as few shards as possible.  Returns a
+    permutation of arange(n) — pass positions/cells through it before
+    create_many so row index ≈ locality."""
+    order = np.argsort(cell, kind="stable")
+    return order

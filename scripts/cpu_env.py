@@ -1,0 +1,20 @@
+"""Import-first helper for ad-hoc scripts: force the CPU backend.
+
+The container's sitecustomize registers the tunnelled-TPU ("axon") backend
+at interpreter start and overrides JAX_PLATFORMS, so env vars alone don't
+keep scratch scripts off the (single, shared, slow-per-op) tunnel chip.
+`import scripts.cpu_env` before anything that touches jax.  Mirrors
+tests/conftest.py.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu"
