@@ -1,0 +1,306 @@
+// nfnet: native epoll TCP runtime for the noahgameframe_tpu network edge.
+//
+// TPU-native replacement for the reference's libevent stack
+// (NFComm/NFNet/NFCNet.cpp): same pump contract (poll once per main-loop
+// tick, no threads touch game state), same 6-byte frame layout
+// (big-endian u16 msgID + u32 total size incl. header,
+// NFComm/NFNet/NFINet.h:168-233), exposed through a flat C API consumed
+// from Python via ctypes (no pybind11 in the image).
+//
+// Event model: poll() performs all ready I/O and stages an event list
+// (CONNECTED / DISCONNECTED / MSG) that the caller walks with accessor
+// functions; bodies live in an arena valid until the next poll().
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kHeadLen = 6;
+constexpr uint32_t kMaxFrame = 64u * 1024u * 1024u;
+constexpr int kEvConnected = 1;
+constexpr int kEvDisconnected = 2;
+constexpr int kEvMsg = 3;
+
+struct Event {
+  int kind;
+  int conn_id;
+  int msg_id;
+  size_t body_off;
+  uint32_t body_len;
+};
+
+struct Conn {
+  int fd = -1;
+  bool connecting = false;
+  std::string inbuf;
+  std::string outbuf;
+};
+
+int set_nonblock(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return flags < 0 ? -1 : fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+}  // namespace
+
+struct nfnet {
+  int epfd = -1;
+  int listen_fd = -1;  // servers only
+  int listen_port = 0;
+  std::string client_host;  // clients only
+  int client_port = 0;
+  int next_id = 1;
+  std::unordered_map<int, Conn> conns;
+  std::unordered_map<int, int> fd2id;
+  std::vector<Event> events;
+  std::string arena;  // MSG bodies for the current event batch
+
+  ~nfnet() {
+    for (auto& kv : conns) close(kv.second.fd);
+    if (listen_fd >= 0) close(listen_fd);
+    if (epfd >= 0) close(epfd);
+  }
+
+  void watch(int fd, bool want_write) {
+    epoll_event ev{};
+    ev.events = EPOLLIN | (want_write ? static_cast<uint32_t>(EPOLLOUT) : 0u);
+    ev.data.fd = fd;
+    epoll_ctl(epfd, EPOLL_CTL_MOD, fd, &ev);
+  }
+
+  int add_conn(int fd, bool connecting) {
+    int id = next_id++;
+    Conn& c = conns[id];
+    c.fd = fd;
+    c.connecting = connecting;
+    fd2id[fd] = id;
+    epoll_event ev{};
+    ev.events = EPOLLIN | (connecting ? static_cast<uint32_t>(EPOLLOUT) : 0u);
+    ev.data.fd = fd;
+    epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
+    return id;
+  }
+
+  void drop_conn(int id, bool notify) {
+    auto it = conns.find(id);
+    if (it == conns.end()) return;
+    epoll_ctl(epfd, EPOLL_CTL_DEL, it->second.fd, nullptr);
+    fd2id.erase(it->second.fd);
+    close(it->second.fd);
+    conns.erase(it);
+    if (notify) events.push_back({kEvDisconnected, id, 0, 0, 0});
+  }
+
+  void extract_frames(int id, Conn& c) {
+    size_t off = 0;
+    const std::string& buf = c.inbuf;
+    while (buf.size() - off >= kHeadLen) {
+      uint16_t msg_id;
+      uint32_t total;
+      memcpy(&msg_id, buf.data() + off, 2);
+      memcpy(&total, buf.data() + off + 2, 4);
+      msg_id = ntohs(msg_id);
+      total = ntohl(total);
+      if (total < kHeadLen || total > kMaxFrame) {
+        drop_conn(id, true);
+        return;
+      }
+      if (buf.size() - off < total) break;
+      uint32_t body_len = total - kHeadLen;
+      events.push_back({kEvMsg, id, msg_id, arena.size(), body_len});
+      arena.append(buf, off + kHeadLen, body_len);
+      off += total;
+    }
+    if (off) c.inbuf.erase(0, off);
+  }
+
+  void pump_conn(int id, uint32_t evmask) {
+    auto it = conns.find(id);
+    if (it == conns.end()) return;
+    Conn& c = it->second;
+    if (c.connecting && (evmask & (EPOLLOUT | EPOLLERR | EPOLLHUP))) {
+      int err = 0;
+      socklen_t len = sizeof(err);
+      getsockopt(c.fd, SOL_SOCKET, SO_ERROR, &err, &len);
+      if (err != 0 || (evmask & (EPOLLERR | EPOLLHUP))) {
+        drop_conn(id, true);
+        return;
+      }
+      c.connecting = false;
+      events.push_back({kEvConnected, id, 0, 0, 0});
+      watch(c.fd, !c.outbuf.empty());
+    }
+    if (evmask & EPOLLIN) {
+      char tmp[256 * 1024];
+      for (;;) {
+        ssize_t n = recv(c.fd, tmp, sizeof(tmp), 0);
+        if (n > 0) {
+          c.inbuf.append(tmp, static_cast<size_t>(n));
+          if (static_cast<size_t>(n) < sizeof(tmp)) break;
+        } else if (n == 0) {
+          drop_conn(id, true);
+          return;
+        } else {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+          drop_conn(id, true);
+          return;
+        }
+      }
+      extract_frames(id, c);
+      if (conns.find(id) == conns.end()) return;  // dropped on bad frame
+    }
+    if ((evmask & EPOLLOUT) && !c.connecting && !c.outbuf.empty()) {
+      ssize_t n = send(c.fd, c.outbuf.data(), c.outbuf.size(), MSG_NOSIGNAL);
+      if (n > 0) {
+        c.outbuf.erase(0, static_cast<size_t>(n));
+      } else if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+        drop_conn(id, true);
+        return;
+      }
+      watch(c.fd, !c.outbuf.empty());
+    }
+    if (evmask & (EPOLLERR | EPOLLHUP)) drop_conn(id, true);
+  }
+};
+
+extern "C" {
+
+nfnet* nfnet_server_create(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return nullptr;
+  }
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(fd, 512) < 0 || set_nonblock(fd) < 0) {
+    close(fd);
+    return nullptr;
+  }
+  sockaddr_in bound{};
+  socklen_t blen = sizeof(bound);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &blen);
+
+  nfnet* h = new nfnet();
+  h->epfd = epoll_create1(0);
+  h->listen_fd = fd;
+  h->listen_port = ntohs(bound.sin_port);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = fd;
+  epoll_ctl(h->epfd, EPOLL_CTL_ADD, fd, &ev);
+  return h;
+}
+
+nfnet* nfnet_client_create(const char* host, int port) {
+  nfnet* h = new nfnet();
+  h->epfd = epoll_create1(0);
+  h->client_host = host;
+  h->client_port = port;
+  return h;
+}
+
+// Begin a non-blocking connect; CONNECTED/DISCONNECTED arrives via poll.
+// Returns the conn id, or -1.
+int nfnet_client_connect(nfnet* h) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  set_nonblock(fd);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(h->client_port));
+  if (inet_pton(AF_INET, h->client_host.c_str(), &addr.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
+  int rc = connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (rc < 0 && errno != EINPROGRESS) {
+    close(fd);
+    return -1;
+  }
+  return h->add_conn(fd, rc != 0);
+}
+
+int nfnet_server_port(nfnet* h) { return h->listen_port; }
+int nfnet_num_conns(nfnet* h) { return static_cast<int>(h->conns.size()); }
+
+int nfnet_poll(nfnet* h) {
+  h->events.clear();
+  h->arena.clear();
+  epoll_event evs[256];
+  int n = epoll_wait(h->epfd, evs, 256, 0);
+  for (int i = 0; i < n; ++i) {
+    int fd = evs[i].data.fd;
+    if (fd == h->listen_fd) {
+      for (;;) {
+        int cfd = accept(h->listen_fd, nullptr, nullptr);
+        if (cfd < 0) break;
+        set_nonblock(cfd);
+        int one = 1;
+        setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        int id = h->add_conn(cfd, false);
+        h->events.push_back({kEvConnected, id, 0, 0, 0});
+      }
+    } else {
+      auto it = h->fd2id.find(fd);
+      if (it != h->fd2id.end()) h->pump_conn(it->second, evs[i].events);
+    }
+  }
+  return static_cast<int>(h->events.size());
+}
+
+int nfnet_event_kind(nfnet* h, int i) { return h->events[i].kind; }
+int nfnet_event_conn(nfnet* h, int i) { return h->events[i].conn_id; }
+int nfnet_event_msgid(nfnet* h, int i) { return h->events[i].msg_id; }
+
+const char* nfnet_event_body(nfnet* h, int i, uint32_t* len) {
+  *len = h->events[i].body_len;
+  return h->arena.data() + h->events[i].body_off;
+}
+
+int nfnet_send(nfnet* h, int conn_id, int msg_id, const char* data,
+               uint32_t len) {
+  auto it = h->conns.find(conn_id);
+  if (it == h->conns.end()) return 0;
+  Conn& c = it->second;
+  char head[kHeadLen];
+  uint16_t mid = htons(static_cast<uint16_t>(msg_id));
+  uint32_t total = htonl(len + kHeadLen);
+  memcpy(head, &mid, 2);
+  memcpy(head + 2, &total, 4);
+  c.outbuf.append(head, kHeadLen);
+  c.outbuf.append(data, len);
+  if (!c.connecting) {
+    // opportunistic immediate flush, then epoll for the rest
+    ssize_t n = send(c.fd, c.outbuf.data(), c.outbuf.size(), MSG_NOSIGNAL);
+    if (n > 0) c.outbuf.erase(0, static_cast<size_t>(n));
+    h->watch(c.fd, !c.outbuf.empty());
+  }
+  return 1;
+}
+
+void nfnet_close_conn(nfnet* h, int conn_id) { h->drop_conn(conn_id, false); }
+void nfnet_destroy(nfnet* h) { delete h; }
+
+}  // extern "C"

@@ -1,0 +1,53 @@
+"""Network stack: the distributed communication edge of the framework.
+
+TPU-native rethink of the reference's libevent/protobuf stack
+(SURVEY §2.4): device-side state exchange rides XLA collectives
+(:mod:`noahgameframe_tpu.parallel`); this package is the *host edge* —
+byte-compatible NF framing + MsgBase envelope for clients and the
+five-role control plane, with a native C++ epoll runtime
+(``native/nfnet.cc``) and a pure-Python fallback.
+"""
+
+from . import defines, framing, wire
+from .defines import MsgID, ServerState, ServerType
+from .framing import FrameDecoder, ProtocolError, pack_frame, unpack_head
+from .module import NetClientModule, NetServerModule
+from .transport import (
+    EV_CONNECTED,
+    EV_DISCONNECTED,
+    EV_MSG,
+    NetEvent,
+    PyNetClient,
+    PyNetServer,
+    create_client,
+    create_server,
+)
+from .wire import Ident, Message, MsgBase, unwrap, wrap
+
+__all__ = [
+    "defines",
+    "framing",
+    "wire",
+    "MsgID",
+    "ServerState",
+    "ServerType",
+    "FrameDecoder",
+    "ProtocolError",
+    "pack_frame",
+    "unpack_head",
+    "NetClientModule",
+    "NetServerModule",
+    "EV_CONNECTED",
+    "EV_DISCONNECTED",
+    "EV_MSG",
+    "NetEvent",
+    "PyNetClient",
+    "PyNetServer",
+    "create_client",
+    "create_server",
+    "Ident",
+    "Message",
+    "MsgBase",
+    "unwrap",
+    "wrap",
+]

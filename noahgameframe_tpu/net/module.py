@@ -1,0 +1,272 @@
+"""Server/client network modules: dispatch, envelope, pool, reconnect.
+
+- :class:`NetServerModule` ≙ the reference's `NFINetModule`
+  (msgID→handler registry, socket-event callbacks, MsgBase envelope
+  send/receive — `NFComm/NFPluginModule/NFINetModule.h:135-520`).
+- :class:`NetClientModule` ≙ `NFINetClientModule.hpp`: outbound pool
+  keyed by server id, per-link NORMAL/CONNECTING/RECONNECT state
+  machine with 10 s backoff (`:312-370`), keepalive hook (`:395-405`),
+  `send_by_server_id` / `send_by_suit` (consistent hash) /
+  `send_to_all` routing (`:151-239`).
+
+Both are pumped from the main loop via ``execute()`` — no threads.
+Time is injected (``now``) so tests can drive the FSM deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from typing import Callable, Dict, List, Optional
+
+from ..core.chash import ConsistentHash
+from .defines import KEEPALIVE_SECONDS, RECONNECT_SECONDS, ServerType
+from .transport import EV_CONNECTED, EV_DISCONNECTED, EV_MSG, NetEvent, create_client, create_server
+from .wire import Ident, Message, MsgBase
+
+ReceiveHandler = Callable[[int, int, bytes], None]  # (conn_id, msg_id, body)
+EventHandler = Callable[[int, int], None]  # (conn_id, event_kind)
+
+
+class _Dispatch:
+    def __init__(self) -> None:
+        self._handlers: Dict[int, List[ReceiveHandler]] = {}
+        self._default: List[ReceiveHandler] = []
+        self._events: List[EventHandler] = []
+
+    def on(self, msg_id: int, fn: ReceiveHandler) -> None:
+        self._handlers.setdefault(int(msg_id), []).append(fn)
+
+    def on_any(self, fn: ReceiveHandler) -> None:
+        """Catch-all for unregistered ids (the proxy's transpond path)."""
+        self._default.append(fn)
+
+    def on_socket_event(self, fn: EventHandler) -> None:
+        self._events.append(fn)
+
+    def feed(self, events: List[NetEvent]) -> None:
+        for ev in events:
+            if ev.kind == EV_MSG:
+                fns = self._handlers.get(ev.msg_id)
+                if fns:
+                    for fn in fns:
+                        fn(ev.conn_id, ev.msg_id, ev.body)
+                else:
+                    for fn in self._default:
+                        fn(ev.conn_id, ev.msg_id, ev.body)
+            else:
+                for fn in self._events:
+                    fn(ev.conn_id, ev.kind)
+
+
+class NetServerModule:
+    """Listening endpoint + dispatch + envelope helpers."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 backend: str = "auto") -> None:
+        self.transport = create_server(host, port, backend=backend)
+        self.host = host
+        self.port = self.transport.port
+        self.dispatch = _Dispatch()
+        # connection tags, mirroring NetObject's account/id binding
+        # (`NFINet.h:246-405`): conn_id -> dict of app tags
+        self.conn_tags: Dict[int, Dict[str, object]] = {}
+        self.dispatch.on_socket_event(self._track)
+
+    def _track(self, conn_id: int, kind: int) -> None:
+        if kind == EV_CONNECTED:
+            self.conn_tags[conn_id] = {}
+        elif kind == EV_DISCONNECTED:
+            self.conn_tags.pop(conn_id, None)
+
+    # -------------------------------------------------------- registry
+    def on(self, msg_id: int, fn: ReceiveHandler) -> None:
+        self.dispatch.on(msg_id, fn)
+
+    def on_any(self, fn: ReceiveHandler) -> None:
+        self.dispatch.on_any(fn)
+
+    def on_socket_event(self, fn: EventHandler) -> None:
+        self.dispatch.on_socket_event(fn)
+
+    # ------------------------------------------------------------ send
+    def send_raw(self, conn_id: int, msg_id: int, body: bytes) -> bool:
+        return self.transport.send(conn_id, msg_id, body)
+
+    def send_pb(self, conn_id: int, msg_id: int, msg: Message,
+                player_id: Optional[Ident] = None,
+                clients: Optional[List[Ident]] = None) -> bool:
+        env = MsgBase(
+            player_id=player_id or Ident(),
+            msg_data=msg.encode(),
+            player_client_list=clients or [],
+        )
+        return self.transport.send(conn_id, msg_id, env.encode())
+
+    def broadcast_pb(self, msg_id: int, msg: Message,
+                     player_id: Optional[Ident] = None) -> None:
+        for conn_id in list(self.conn_tags):
+            self.send_pb(conn_id, msg_id, msg, player_id=player_id)
+
+    def close_conn(self, conn_id: int) -> None:
+        self.transport.close_conn(conn_id)
+        self.conn_tags.pop(conn_id, None)
+
+    # ------------------------------------------------------------ pump
+    def execute(self) -> None:
+        self.dispatch.feed(self.transport.poll())
+
+    def shut(self) -> None:
+        self.transport.close()
+
+    @property
+    def num_connections(self) -> int:
+        return len(self.conn_tags)
+
+
+# connection-pool FSM states (NFINetClientModule.hpp ConnectDataState)
+DISCONNECT, CONNECTING, NORMAL, RECONNECT = 0, 1, 2, 3
+
+
+@dataclasses.dataclass
+class ServerData:
+    server_id: int
+    server_type: int
+    ip: str
+    port: int
+    name: str = ""
+    state: int = DISCONNECT
+    last_attempt: float = 0.0
+    client: object = None  # transport client
+
+
+class NetClientModule:
+    """Outbound connection pool with consistent-hash routing."""
+
+    def __init__(self, backend: str = "auto",
+                 reconnect_seconds: float = RECONNECT_SECONDS,
+                 keepalive_seconds: float = KEEPALIVE_SECONDS) -> None:
+        self._backend = backend
+        self.servers: Dict[int, ServerData] = {}
+        self.ring: ConsistentHash[int] = ConsistentHash()
+        self.dispatch = _Dispatch()
+        self.reconnect_seconds = reconnect_seconds
+        self.keepalive_seconds = keepalive_seconds
+        self._last_keepalive = 0.0
+        self._keepalive_fns: List[Callable[[], None]] = []
+        self._connected_fns: List[Callable[[int], None]] = []
+
+    # -------------------------------------------------------- topology
+    def add_server(self, server_id: int, server_type: int, ip: str,
+                   port: int, name: str = "") -> None:
+        """Register a target endpoint (AddServer,
+        `NFINetClientModule.hpp:90-110`); connection happens in execute()."""
+        if server_id in self.servers:
+            return
+        self.servers[server_id] = ServerData(server_id, server_type, ip, port, name)
+        self.ring.add(str(server_id), server_id)
+
+    def remove_server(self, server_id: int) -> None:
+        sd = self.servers.pop(server_id, None)
+        if sd is not None:
+            if sd.client is not None:
+                sd.client.close()
+            self.ring.remove(str(server_id))
+
+    # -------------------------------------------------------- registry
+    def on(self, msg_id: int, fn: ReceiveHandler) -> None:
+        """Handler receives (server_id, msg_id, body)."""
+        self.dispatch.on(msg_id, fn)
+
+    def on_any(self, fn: ReceiveHandler) -> None:
+        self.dispatch.on_any(fn)
+
+    def on_connected(self, fn: Callable[[int], None]) -> None:
+        self._connected_fns.append(fn)
+
+    def on_keepalive(self, fn: Callable[[], None]) -> None:
+        """Called every keepalive period (the ServerInfoReport hook)."""
+        self._keepalive_fns.append(fn)
+
+    # ------------------------------------------------------------ send
+    def send_by_server_id(self, server_id: int, msg_id: int, body: bytes) -> bool:
+        sd = self.servers.get(server_id)
+        if sd is None or sd.state != NORMAL:
+            return False
+        return sd.client.send_msg(msg_id, body)
+
+    def send_pb_by_server_id(self, server_id: int, msg_id: int, msg: Message,
+                             player_id: Optional[Ident] = None,
+                             clients: Optional[List[Ident]] = None) -> bool:
+        env = MsgBase(player_id=player_id or Ident(), msg_data=msg.encode(),
+                      player_client_list=clients or [])
+        return self.send_by_server_id(server_id, msg_id, env.encode())
+
+    def send_by_suit(self, key: str, msg_id: int, body: bytes) -> bool:
+        """Consistent-hash routing (`SendBySuit`,
+        `NFINetClientModule.hpp:214-239`)."""
+        sid = self.ring.get(key)
+        return sid is not None and self.send_by_server_id(sid, msg_id, body)
+
+    def send_to_all(self, msg_id: int, body: bytes,
+                    server_type: Optional[int] = None) -> int:
+        n = 0
+        for sd in self.servers.values():
+            if server_type is not None and sd.server_type != server_type:
+                continue
+            if self.send_by_server_id(sd.server_id, msg_id, body):
+                n += 1
+        return n
+
+    def connected_servers(self, server_type: Optional[int] = None) -> List[int]:
+        return [
+            sd.server_id
+            for sd in self.servers.values()
+            if sd.state == NORMAL
+            and (server_type is None or sd.server_type == server_type)
+        ]
+
+    # ------------------------------------------------------------ pump
+    def execute(self, now: Optional[float] = None) -> None:
+        now = _time.monotonic() if now is None else now
+        for sd in self.servers.values():
+            self._pump_link(sd, now)
+        if now - self._last_keepalive >= self.keepalive_seconds:
+            self._last_keepalive = now
+            for fn in self._keepalive_fns:
+                fn()
+
+    def _pump_link(self, sd: ServerData, now: float) -> None:
+        if sd.state in (DISCONNECT, RECONNECT):
+            if sd.state == RECONNECT and now - sd.last_attempt < self.reconnect_seconds:
+                return
+            if sd.client is not None:
+                sd.client.close()
+            sd.client = create_client(sd.ip, sd.port, backend=self._backend)
+            sd.client.connect()
+            sd.state = CONNECTING
+            sd.last_attempt = now
+            return
+        events = sd.client.poll()
+        for ev in events:
+            if ev.kind == EV_CONNECTED:
+                sd.state = NORMAL
+                for fn in self._connected_fns:
+                    fn(sd.server_id)
+            elif ev.kind == EV_DISCONNECTED:
+                sd.state = RECONNECT
+                sd.last_attempt = now
+            elif ev.kind == EV_MSG:
+                # present the *server id* as the connection identity
+                self.dispatch.feed(
+                    [NetEvent(EV_MSG, sd.server_id, ev.msg_id, ev.body)]
+                )
+        if sd.state == CONNECTING and now - sd.last_attempt > self.reconnect_seconds:
+            sd.client.disconnect()
+            sd.state = RECONNECT
+            sd.last_attempt = now
+
+    def shut(self) -> None:
+        for sd in self.servers.values():
+            if sd.client is not None:
+                sd.client.close()

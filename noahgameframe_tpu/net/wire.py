@@ -1,0 +1,592 @@
+"""Minimal proto2 wire-format codec + the NF message set.
+
+The reference speaks protobuf (`NFComm/NFMessageDefine/*.proto`) inside
+its 6-byte frames; to stay byte-compatible with existing Unity/Cocos
+clients without depending on protoc-generated code, this module
+implements the protobuf wire format directly (varint / fixed32 /
+length-delimited) and declares the handful of messages the framework
+needs (`NFMsgBase.proto`, `NFMsgPreGame.proto`, `NFMsgShare.proto`).
+
+Messages are declared with a tiny DSL:
+
+    class Ident(Message):
+        FIELDS = [(1, "svrid", "int64", 0), (2, "index", "int64", 0)]
+
+Encoding skips fields equal to ``None``; decoding tolerates unknown
+fields (skips them by wire type), matching protobuf semantics.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+# ---------------------------------------------------------------- varint
+
+
+def _enc_varint(out: bytearray, v: int) -> None:
+    if v < 0:
+        v &= (1 << 64) - 1  # proto2 negative ints are 10-byte varints
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _dec_varint(buf: bytes, off: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[off]
+        off += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, off
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+
+
+def _signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _signed32(v: int) -> int:
+    v &= (1 << 32) - 1
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+_F32 = struct.Struct("<f")
+_F64 = struct.Struct("<d")
+
+# wire types
+_WT_VARINT, _WT_64BIT, _WT_LEN, _WT_32BIT = 0, 1, 2, 5
+
+_WIRE_TYPE = {
+    "int32": _WT_VARINT,
+    "int64": _WT_VARINT,
+    "uint64": _WT_VARINT,
+    "bool": _WT_VARINT,
+    "enum": _WT_VARINT,
+    "float": _WT_32BIT,
+    "double": _WT_64BIT,
+    "bytes": _WT_LEN,
+    "string": _WT_LEN,
+}
+
+
+class Message:
+    """Base class: subclasses declare FIELDS = [(tag, name, type, default)].
+
+    type is one of the scalar names above, a Message subclass (embedded
+    message), or ("repeated", inner) for repeated fields.
+    """
+
+    FIELDS: List[Tuple[int, str, Any, Any]] = []
+
+    # populated lazily per-class
+    _by_tag: Optional[Dict[int, Tuple[str, Any, bool]]] = None
+
+    def __init__(self, **kw: Any) -> None:
+        for _, name, ftype, default in self.FIELDS:
+            if isinstance(ftype, tuple):  # repeated
+                setattr(self, name, list(kw.get(name) or []))
+            else:
+                setattr(self, name, kw.get(name, default))
+        bad = set(kw) - {f[1] for f in self.FIELDS}
+        if bad:
+            raise TypeError(f"{type(self).__name__}: unknown fields {bad}")
+
+    # -------------------------------------------------------- encoding
+    def encode(self) -> bytes:
+        out = bytearray()
+        for tag, name, ftype, _ in self.FIELDS:
+            val = getattr(self, name)
+            if isinstance(ftype, tuple):
+                inner = ftype[1]
+                for item in val:
+                    _enc_field(out, tag, inner, item)
+            elif val is not None:
+                _enc_field(out, tag, ftype, val)
+        return bytes(out)
+
+    # -------------------------------------------------------- decoding
+    @classmethod
+    def decode(cls, data: bytes) -> "Message":
+        if cls._by_tag is None or "_by_tag" not in cls.__dict__:
+            cls._by_tag = {
+                tag: (name, ftype, isinstance(ftype, tuple))
+                for tag, name, ftype, _ in cls.FIELDS
+            }
+        msg = cls()
+        off = 0
+        n = len(data)
+        while off < n:
+            key, off = _dec_varint(data, off)
+            tag, wt = key >> 3, key & 7
+            spec = cls._by_tag.get(tag)
+            if spec is None:
+                off = _skip(data, off, wt)
+                continue
+            name, ftype, repeated = spec
+            inner = ftype[1] if repeated else ftype
+            val, off = _dec_field(data, off, wt, inner)
+            if repeated:
+                getattr(msg, name).append(val)
+            else:
+                setattr(msg, name, val)
+        return msg
+
+    # ------------------------------------------------------ niceties
+    def __repr__(self) -> str:
+        parts = ", ".join(
+            f"{name}={getattr(self, name)!r}"
+            for _, name, _, _ in self.FIELDS
+            if getattr(self, name) not in (None, [])
+        )
+        return f"{type(self).__name__}({parts})"
+
+    def __eq__(self, other: object) -> bool:
+        return type(self) is type(other) and all(
+            getattr(self, f[1]) == getattr(other, f[1]) for f in self.FIELDS
+        )
+
+
+def _enc_field(out: bytearray, tag: int, ftype: Any, val: Any) -> None:
+    if isinstance(ftype, type) and issubclass(ftype, Message):
+        _enc_varint(out, tag << 3 | _WT_LEN)
+        body = val.encode()
+        _enc_varint(out, len(body))
+        out.extend(body)
+        return
+    wt = _WIRE_TYPE[ftype]
+    _enc_varint(out, tag << 3 | wt)
+    if wt == _WT_VARINT:
+        _enc_varint(out, int(val))
+    elif wt == _WT_32BIT:
+        out.extend(_F32.pack(val))
+    elif wt == _WT_64BIT:
+        out.extend(_F64.pack(val))
+    else:
+        if isinstance(val, str):
+            val = val.encode("utf-8")
+        _enc_varint(out, len(val))
+        out.extend(val)
+
+
+def _dec_field(buf: bytes, off: int, wt: int, ftype: Any) -> Tuple[Any, int]:
+    if isinstance(ftype, type) and issubclass(ftype, Message):
+        ln, off = _dec_varint(buf, off)
+        return ftype.decode(buf[off : off + ln]), off + ln
+    if wt == _WT_VARINT:
+        v, off = _dec_varint(buf, off)
+        if ftype == "int32":
+            v = _signed32(v)
+        elif ftype == "int64":
+            v = _signed64(v)
+        elif ftype == "bool":
+            v = bool(v)
+        return v, off
+    if wt == _WT_32BIT:
+        return _F32.unpack_from(buf, off)[0], off + 4
+    if wt == _WT_64BIT:
+        return _F64.unpack_from(buf, off)[0], off + 8
+    ln, off = _dec_varint(buf, off)
+    return bytes(buf[off : off + ln]), off + ln
+
+
+def _skip(buf: bytes, off: int, wt: int) -> int:
+    if wt == _WT_VARINT:
+        _, off = _dec_varint(buf, off)
+        return off
+    if wt == _WT_64BIT:
+        return off + 8
+    if wt == _WT_32BIT:
+        return off + 4
+    if wt == _WT_LEN:
+        ln, off = _dec_varint(buf, off)
+        return off + ln
+    raise ValueError(f"unsupported wire type {wt}")
+
+
+def R(inner: Any) -> Tuple[str, Any]:
+    """repeated-field marker."""
+    return ("repeated", inner)
+
+
+# =====================================================================
+# NFMsgBase.proto equivalents (field tags byte-compatible)
+# =====================================================================
+
+
+class Ident(Message):
+    """128-bit GUID on the wire (`NFMsgBase.proto` Ident{svrid,index})."""
+
+    FIELDS = [(1, "svrid", "int64", 0), (2, "index", "int64", 0)]
+
+
+class Vector2(Message):
+    FIELDS = [(1, "x", "float", 0.0), (2, "y", "float", 0.0)]
+
+
+class Vector3(Message):
+    FIELDS = [(1, "x", "float", 0.0), (2, "y", "float", 0.0), (3, "z", "float", 0.0)]
+
+
+class MsgBase(Message):
+    """The routing envelope every framed payload is wrapped in
+    (`NFMsgBase.proto:281-287`)."""
+
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "msg_data", "bytes", b""),
+        (3, "player_client_list", R(Ident), None),
+        (4, "hash_ident", Ident, None),
+    ]
+
+
+class Position(Message):
+    FIELDS = [(1, "x", "float", 0.0), (2, "y", "float", 0.0), (3, "z", "float", 0.0)]
+
+
+# ---- property / record sync ----------------------------------------
+
+
+class PropertyInt(Message):
+    FIELDS = [(1, "property_name", "bytes", b""), (2, "data", "int64", 0)]
+
+
+class PropertyFloat(Message):
+    FIELDS = [(1, "property_name", "bytes", b""), (2, "data", "float", 0.0)]
+
+
+class PropertyString(Message):
+    FIELDS = [(1, "property_name", "bytes", b""), (2, "data", "bytes", b"")]
+
+
+class PropertyObject(Message):
+    FIELDS = [(1, "property_name", "bytes", b""), (2, "data", Ident, None)]
+
+
+class PropertyVector2(Message):
+    FIELDS = [(1, "property_name", "bytes", b""), (2, "data", Vector2, None)]
+
+
+class PropertyVector3(Message):
+    FIELDS = [(1, "property_name", "bytes", b""), (2, "data", Vector3, None)]
+
+
+class ObjectPropertyList(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "property_int_list", R(PropertyInt), None),
+        (3, "property_float_list", R(PropertyFloat), None),
+        (4, "property_string_list", R(PropertyString), None),
+        (5, "property_object_list", R(PropertyObject), None),
+        (6, "property_vector2_list", R(PropertyVector2), None),
+        (7, "property_vector3_list", R(PropertyVector3), None),
+    ]
+
+
+class ObjectPropertyInt(Message):
+    FIELDS = [(1, "player_id", Ident, None), (2, "property_list", R(PropertyInt), None)]
+
+
+class ObjectPropertyFloat(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "property_list", R(PropertyFloat), None),
+    ]
+
+
+class RecordInt(Message):
+    FIELDS = [(1, "row", "int32", 0), (2, "col", "int32", 0), (3, "data", "int64", 0)]
+
+
+class RecordFloat(Message):
+    FIELDS = [(1, "row", "int32", 0), (2, "col", "int32", 0), (3, "data", "float", 0.0)]
+
+
+class RecordString(Message):
+    FIELDS = [(1, "row", "int32", 0), (2, "col", "int32", 0), (3, "data", "bytes", b"")]
+
+
+class RecordAddRowStruct(Message):
+    FIELDS = [
+        (1, "row", "int32", 0),
+        (2, "record_int_list", R(RecordInt), None),
+        (3, "record_float_list", R(RecordFloat), None),
+        (4, "record_string_list", R(RecordString), None),
+    ]
+
+
+class ObjectRecordBase(Message):
+    FIELDS = [
+        (1, "record_name", "bytes", b""),
+        (2, "row_struct", R(RecordAddRowStruct), None),
+    ]
+
+
+class ObjectRecordList(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "record_list", R(ObjectRecordBase), None),
+    ]
+
+
+# =====================================================================
+# NFMsgPreGame.proto equivalents — cluster control plane
+# =====================================================================
+
+
+class ServerInfoExt(Message):
+    FIELDS = [(1, "key", R("bytes"), None), (2, "value", R("bytes"), None)]
+
+
+class ServerInfoReport(Message):
+    """10-second server heartbeat to Master (`NFMsgPreGame.proto:24-36`)."""
+
+    FIELDS = [
+        (1, "server_id", "int32", 0),
+        (2, "server_name", "bytes", b""),
+        (3, "server_ip", "bytes", b""),
+        (4, "server_port", "int32", 0),
+        (5, "server_max_online", "int32", 0),
+        (6, "server_cur_count", "int32", 0),
+        (7, "server_state", "enum", 1),
+        (8, "server_type", "int32", 0),
+        (9, "server_info_list_ext", ServerInfoExt, None),
+    ]
+
+
+class ServerInfoReportList(Message):
+    FIELDS = [(1, "server_list", R(ServerInfoReport), None)]
+
+
+class AckEventResult(Message):
+    FIELDS = [
+        (1, "event_code", "enum", 0),
+        (2, "event_object", Ident, None),
+        (3, "event_client", Ident, None),
+    ]
+
+
+class ReqAccountLogin(Message):
+    FIELDS = [
+        (2, "account", "bytes", b""),
+        (3, "password", "bytes", b""),
+        (4, "security_code", "bytes", b""),
+        (5, "sign_buff", "bytes", b""),
+        (6, "client_version", "int32", 0),
+        (7, "login_mode", "int32", 0),
+        (8, "client_ip", "int32", 0),
+        (9, "client_mac", "int64", 0),
+        (10, "device_info", "bytes", b""),
+        (11, "extra_info", "bytes", b""),
+        (12, "platform_type", "int32", None),
+    ]
+
+
+class ServerInfo(Message):
+    FIELDS = [
+        (1, "server_id", "int32", 0),
+        (2, "name", "bytes", b""),
+        (3, "wait_count", "int32", 0),
+        (4, "status", "enum", 1),
+    ]
+
+
+class ReqServerList(Message):
+    FIELDS = [(1, "type", "enum", 0)]
+
+
+class AckServerList(Message):
+    FIELDS = [(1, "type", "enum", 0), (2, "info", R(ServerInfo), None)]
+
+
+class ReqConnectWorld(Message):
+    FIELDS = [
+        (1, "world_id", "int32", 0),
+        (2, "account", "bytes", b""),
+        (3, "sender", Ident, None),
+        (4, "login_id", "int32", 0),
+    ]
+
+
+class AckConnectWorldResult(Message):
+    FIELDS = [
+        (1, "world_id", "int32", 0),
+        (2, "sender", Ident, None),
+        (3, "login_id", "int32", 0),
+        (4, "account", "bytes", b""),
+        (5, "world_ip", "bytes", b""),
+        (6, "world_port", "int32", 0),
+        (7, "world_key", "bytes", b""),
+    ]
+
+
+class ReqSelectServer(Message):
+    FIELDS = [(1, "world_id", "int32", 0)]
+
+
+class ReqRoleList(Message):
+    FIELDS = [(1, "game_id", "int32", 0), (2, "account", "bytes", b"")]
+
+
+class RoleLiteInfo(Message):
+    FIELDS = [
+        (1, "id", Ident, None),
+        (2, "career", "int32", 0),
+        (3, "sex", "int32", 0),
+        (4, "race", "int32", 0),
+        (5, "noob_name", "bytes", b""),
+        (6, "game_id", "int32", 0),
+        (7, "role_level", "int32", 0),
+        (8, "delete_time", "int32", 0),
+        (9, "reg_time", "int32", 0),
+        (10, "last_offline_time", "int32", 0),
+        (11, "last_offline_ip", "int32", 0),
+        (12, "view_record", "bytes", b""),
+    ]
+
+
+class AckRoleLiteInfoList(Message):
+    FIELDS = [(1, "char_data", R(RoleLiteInfo), None)]
+
+
+class ReqCreateRole(Message):
+    FIELDS = [
+        (1, "account", "bytes", b""),
+        (2, "career", "int32", 0),
+        (3, "sex", "int32", 0),
+        (4, "race", "int32", 0),
+        (5, "noob_name", "bytes", b""),
+        (6, "game_id", "int32", 0),
+    ]
+
+
+class ReqDeleteRole(Message):
+    FIELDS = [
+        (1, "account", "bytes", b""),
+        (2, "name", "bytes", b""),
+        (3, "game_id", "int32", 0),
+    ]
+
+
+class ServerHeartBeat(Message):
+    FIELDS = [(1, "count", "int32", 0)]
+
+
+# =====================================================================
+# NFMsgShare.proto equivalents — in-game
+# =====================================================================
+
+
+class ReqEnterGameServer(Message):
+    FIELDS = [
+        (1, "id", Ident, None),
+        (2, "account", "bytes", b""),
+        (3, "game_id", "int32", 0),
+        (4, "name", "bytes", b""),
+    ]
+
+
+class PlayerEntryInfo(Message):
+    FIELDS = [
+        (1, "object_guid", Ident, None),
+        (2, "x", "float", 0.0),
+        (3, "y", "float", 0.0),
+        (4, "z", "float", 0.0),
+        (5, "career_type", "int32", 0),
+        (6, "player_state", "int32", 0),
+        (7, "config_id", "bytes", b""),
+        (8, "scene_id", "int32", 0),
+        (9, "class_id", "bytes", b""),
+    ]
+
+
+class AckPlayerEntryList(Message):
+    FIELDS = [(1, "object_list", R(PlayerEntryInfo), None)]
+
+
+class AckPlayerLeaveList(Message):
+    FIELDS = [(1, "object_list", R(Ident), None)]
+
+
+class ReqAckPlayerMove(Message):
+    FIELDS = [
+        (1, "mover", Ident, None),
+        (2, "move_type", "int32", 0),
+        (3, "target_pos", R(Position), None),
+        (4, "source_pos", R(Position), None),
+    ]
+
+
+class ChatContainer(Message):
+    FIELDS = [(2, "container_type", "int32", 0), (3, "data_info", "bytes", b"")]
+
+
+class ReqAckPlayerChat(Message):
+    FIELDS = [
+        (1, "chat_id", Ident, None),
+        (2, "chat_type", "enum", 0),
+        (3, "chat_info", "bytes", b""),
+        (4, "chat_name", "bytes", b""),
+        (5, "target_id", Ident, None),
+        (6, "container_data", R(ChatContainer), None),
+    ]
+
+
+class EffectData(Message):
+    FIELDS = [
+        (1, "effect_ident", Ident, None),
+        (2, "effect_value", "int32", 0),
+        (3, "effect_rlt", "enum", 0),
+    ]
+
+
+class ReqAckUseSkill(Message):
+    FIELDS = [
+        (1, "user", Ident, None),
+        (2, "skill_id", "bytes", b""),
+        (3, "now_pos", Position, None),
+        (4, "tar_pos", Position, None),
+        (5, "use_index", "int32", 0),
+        (6, "effect_data", R(EffectData), None),
+    ]
+
+
+class ReqAckSwapScene(Message):
+    FIELDS = [
+        (1, "transfer_type", "enum", 0),
+        (2, "scene_id", "int32", 0),
+        (3, "line_id", "int32", 0),
+        (4, "x", "float", None),
+        (5, "y", "float", None),
+        (6, "z", "float", None),
+    ]
+
+
+def wrap(msg: Message, player_id: Optional[Ident] = None, clients=None,
+         hash_ident: Optional[Ident] = None) -> bytes:
+    """Encode a payload inside the MsgBase envelope (SendMsgPB path,
+    `NFINetModule.h:316-471`)."""
+    return MsgBase(
+        player_id=player_id or Ident(),
+        msg_data=msg.encode(),
+        player_client_list=clients or [],
+        hash_ident=hash_ident,
+    ).encode()
+
+
+def unwrap(data: bytes, payload_cls: Optional[Type[Message]] = None):
+    """Decode a MsgBase envelope; optionally decode its payload too
+    (ReceivePB path, `NFINetModule.h:263-300`)."""
+    base = MsgBase.decode(data)
+    if payload_cls is None:
+        return base, None
+    return base, payload_cls.decode(base.msg_data)
