@@ -1,0 +1,3 @@
+"""Client SDK: the Unity3D/Cocos-equivalent connection + mirror layer."""
+
+from .sdk import GameClient, MirrorObject  # noqa: F401

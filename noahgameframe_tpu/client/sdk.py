@@ -1,0 +1,346 @@
+"""Python client SDK mirroring the reference Unity3D/Cocos clients.
+
+Reference: `NFClient/Unity3D` — the C# SDK drives the login → select-world
+→ connect-key → select-server → role → enter-game pipeline and keeps a
+local mirror of every synced object by decoding the property/record sync
+messages (SURVEY §2.10 L12).  This is the same state machine in Python:
+pump-driven (call ``execute()`` from your loop), every received payload is
+a MsgBase envelope (the proxy transponds envelopes verbatim).
+
+Used by the integration tests as the "player" end of the five-role
+cluster, and usable as a bot/load-test client against a real deployment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..net.defines import EventCode, MsgID
+from ..net.transport import EV_CONNECTED, EV_DISCONNECTED, EV_MSG, PyNetClient
+from ..net.wire import (
+    AckConnectWorldResult,
+    AckEventResult,
+    AckPlayerEntryList,
+    AckPlayerLeaveList,
+    AckRoleLiteInfoList,
+    AckServerList,
+    Ident,
+    Message,
+    MsgBase,
+    ObjectPropertyFloat,
+    ObjectPropertyInt,
+    ObjectPropertyList,
+    ObjectRecordList,
+    Position,
+    ReqAccountLogin,
+    ReqAckPlayerChat,
+    ReqAckPlayerMove,
+    ReqAckUseSkill,
+    ReqConnectWorld,
+    ReqCreateRole,
+    ReqEnterGameServer,
+    ReqRoleList,
+    ReqSelectServer,
+    RoleLiteInfo,
+    ident_key as _key,
+    unwrap,
+    wrap,
+)
+
+_IdentKey = Tuple[int, int]
+
+
+@dataclasses.dataclass
+class MirrorObject:
+    """Client-side replica of one synced entity."""
+
+    ident: Ident
+    class_id: str = ""
+    config_id: str = ""
+    scene_id: int = 0
+    position: Tuple[float, float, float] = (0.0, 0.0, 0.0)
+    properties: Dict[str, object] = dataclasses.field(default_factory=dict)
+    records: Dict[str, Dict[Tuple[int, int], object]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+class GameClient:
+    """One player's connection state machine + world mirror."""
+
+    def __init__(self, account: str, password: str = "") -> None:
+        self.account = account
+        self.password = password
+        self._conn: Optional[PyNetClient] = None
+        self.connected = False
+        # handshake state
+        self.logged_in = False
+        self.worlds: List = []
+        self.world_grant: Optional[AckConnectWorldResult] = None
+        self.key_verified = False
+        self.server_selected = False
+        self.roles: List[RoleLiteInfo] = []
+        self.player_ident: Optional[Ident] = None  # proxy-assigned client id
+        self.player_guid: Optional[Ident] = None  # game-side avatar guid
+        self.entered = False
+        # the world mirror
+        self.objects: Dict[_IdentKey, MirrorObject] = {}
+        self.chat_log: List[Tuple[str, str]] = []
+        self.moves: List[ReqAckPlayerMove] = []
+        self.skills: List[ReqAckUseSkill] = []
+        self._handlers: Dict[int, Callable[[MsgBase], None]] = {}
+        self._install()
+
+    # ------------------------------------------------------------- wiring
+    def _install(self) -> None:
+        h = self._handlers
+        h[int(MsgID.ACK_LOGIN)] = self._on_login
+        h[int(MsgID.ACK_WORLD_LIST)] = self._on_world_list
+        h[int(MsgID.ACK_CONNECT_WORLD)] = self._on_connect_world
+        h[int(MsgID.ACK_CONNECT_KEY)] = self._on_connect_key
+        h[int(MsgID.ACK_SELECT_SERVER)] = self._on_select_server
+        h[int(MsgID.ACK_ROLE_LIST)] = self._on_role_list
+        h[int(MsgID.ACK_ENTER_GAME)] = self._on_enter_game
+        h[int(MsgID.ACK_OBJECT_ENTRY)] = self._on_object_entry
+        h[int(MsgID.ACK_OBJECT_LEAVE)] = self._on_object_leave
+        h[int(MsgID.ACK_OBJECT_PROPERTY_ENTRY)] = self._on_property_list
+        h[int(MsgID.ACK_PROPERTY_VECTOR3)] = self._on_property_list
+        h[int(MsgID.ACK_PROPERTY_STRING)] = self._on_property_list
+        h[int(MsgID.ACK_OBJECT_RECORD_ENTRY)] = self._on_record_list
+        h[int(MsgID.ACK_PROPERTY_INT)] = self._on_property_int
+        h[int(MsgID.ACK_PROPERTY_FLOAT)] = self._on_property_float
+        h[int(MsgID.ACK_MOVE)] = self._on_move
+        h[int(MsgID.ACK_CHAT)] = self._on_chat
+        h[int(MsgID.ACK_SKILL_OBJECTX)] = self._on_skill
+
+    def connect(self, host: str, port: int) -> None:
+        """Dial an endpoint (login first, later the granted proxy)."""
+        if self._conn is not None:
+            self._conn.close()
+        self.connected = False
+        self._conn = PyNetClient(host, port)
+        self._conn.connect()
+
+    def execute(self) -> None:
+        if self._conn is None:
+            return
+        for ev in self._conn.poll():
+            if ev.kind == EV_CONNECTED:
+                self.connected = True
+            elif ev.kind == EV_DISCONNECTED:
+                self.connected = False
+            elif ev.kind == EV_MSG:
+                base = MsgBase.decode(ev.body)
+                fn = self._handlers.get(ev.msg_id)
+                if fn is not None:
+                    fn(base)
+
+    def _send(self, msg_id: int, msg: Message) -> bool:
+        return self._conn is not None and self._conn.send_msg(
+            int(msg_id), wrap(msg)
+        )
+
+    # ------------------------------------------------------------- login flow
+    def login(self) -> None:
+        self._send(
+            MsgID.REQ_LOGIN,
+            ReqAccountLogin(
+                account=self.account.encode(), password=self.password.encode()
+            ),
+        )
+
+    def _on_login(self, base: MsgBase) -> None:
+        ack = AckEventResult.decode(base.msg_data)
+        self.logged_in = int(ack.event_code) == int(EventCode.ACCOUNT_SUCCESS)
+
+    def request_world_list(self) -> None:
+        from ..net.wire import ReqServerList
+        from ..net.defines import ServerType
+
+        self._send(
+            MsgID.REQ_WORLD_LIST, ReqServerList(type=int(ServerType.WORLD))
+        )
+
+    def _on_world_list(self, base: MsgBase) -> None:
+        self.worlds = list(AckServerList.decode(base.msg_data).info)
+
+    def connect_world(self, world_id: int) -> None:
+        self._send(MsgID.REQ_CONNECT_WORLD, ReqConnectWorld(world_id=world_id))
+
+    def _on_connect_world(self, base: MsgBase) -> None:
+        self.world_grant = AckConnectWorldResult.decode(base.msg_data)
+
+    # ------------------------------------------------------------- proxy flow
+    def connect_proxy(self) -> None:
+        """Dial the granted proxy and present the connect key."""
+        g = self.world_grant
+        if g is None:
+            raise RuntimeError("no world grant yet")
+        self.connect(g.world_ip.decode(), g.world_port)
+
+    def verify_key(self) -> None:
+        g = self.world_grant
+        self._send(
+            MsgID.REQ_CONNECT_KEY,
+            ReqAccountLogin(
+                account=self.account.encode(), security_code=g.world_key
+            ),
+        )
+
+    def _on_connect_key(self, base: MsgBase) -> None:
+        ack = AckEventResult.decode(base.msg_data)
+        if int(ack.event_code) == int(EventCode.VERIFY_KEY_SUCCESS):
+            self.key_verified = True
+            self.player_ident = ack.event_object
+
+    def select_server(self, game_id: int) -> None:
+        self._send(MsgID.REQ_SELECT_SERVER, ReqSelectServer(world_id=game_id))
+
+    def _on_select_server(self, base: MsgBase) -> None:
+        ack = AckEventResult.decode(base.msg_data)
+        self.server_selected = int(ack.event_code) == int(
+            EventCode.SELECTSERVER_SUCCESS
+        )
+
+    # ------------------------------------------------------------- role flow
+    def request_role_list(self, game_id: int = 0) -> None:
+        self._send(
+            MsgID.REQ_ROLE_LIST,
+            ReqRoleList(game_id=game_id, account=self.account.encode()),
+        )
+
+    def create_role(self, name: str, career: int = 0, game_id: int = 0) -> None:
+        self._send(
+            MsgID.REQ_CREATE_ROLE,
+            ReqCreateRole(
+                account=self.account.encode(),
+                noob_name=name.encode(),
+                career=career,
+                game_id=game_id,
+            ),
+        )
+
+    def _on_role_list(self, base: MsgBase) -> None:
+        self.roles = list(AckRoleLiteInfoList.decode(base.msg_data).char_data)
+
+    def enter_game(self, name: str, game_id: int = 0) -> None:
+        self._send(
+            MsgID.REQ_ENTER_GAME,
+            ReqEnterGameServer(
+                id=self.player_ident,
+                account=self.account.encode(),
+                name=name.encode(),
+                game_id=game_id,
+            ),
+        )
+
+    def _on_enter_game(self, base: MsgBase) -> None:
+        ack = AckEventResult.decode(base.msg_data)
+        if int(ack.event_code) == int(EventCode.ENTER_GAME_SUCCESS):
+            self.entered = True
+            self.player_guid = ack.event_object
+
+    # ------------------------------------------------------------- mirror
+    def _obj(self, ident: Optional[Ident]) -> MirrorObject:
+        k = _key(ident)
+        if k not in self.objects:
+            self.objects[k] = MirrorObject(ident=ident or Ident())
+        return self.objects[k]
+
+    def _on_object_entry(self, base: MsgBase) -> None:
+        for e in AckPlayerEntryList.decode(base.msg_data).object_list:
+            o = self._obj(e.object_guid)
+            o.class_id = e.class_id.decode("utf-8", "replace")
+            o.config_id = e.config_id.decode("utf-8", "replace")
+            o.scene_id = e.scene_id
+            o.position = (e.x, e.y, e.z)
+
+    def _on_object_leave(self, base: MsgBase) -> None:
+        for ident in AckPlayerLeaveList.decode(base.msg_data).object_list:
+            self.objects.pop(_key(ident), None)
+
+    def _on_property_list(self, base: MsgBase) -> None:
+        pl = ObjectPropertyList.decode(base.msg_data)
+        o = self._obj(pl.player_id)
+        for p in pl.property_int_list:
+            o.properties[p.property_name.decode()] = int(p.data)
+        for p in pl.property_float_list:
+            o.properties[p.property_name.decode()] = float(p.data)
+        for p in pl.property_string_list:
+            o.properties[p.property_name.decode()] = p.data.decode("utf-8", "replace")
+        for p in pl.property_vector3_list:
+            v = p.data
+            o.properties[p.property_name.decode()] = (
+                (v.x, v.y, v.z) if v is not None else (0.0, 0.0, 0.0)
+            )
+
+    def _on_property_int(self, base: MsgBase) -> None:
+        pl = ObjectPropertyInt.decode(base.msg_data)
+        o = self._obj(pl.player_id)
+        for p in pl.property_list:
+            o.properties[p.property_name.decode()] = int(p.data)
+
+    def _on_property_float(self, base: MsgBase) -> None:
+        pl = ObjectPropertyFloat.decode(base.msg_data)
+        o = self._obj(pl.player_id)
+        for p in pl.property_list:
+            o.properties[p.property_name.decode()] = float(p.data)
+
+    def _on_record_list(self, base: MsgBase) -> None:
+        rl = ObjectRecordList.decode(base.msg_data)
+        o = self._obj(rl.player_id)
+        for rec in rl.record_list:
+            cells = o.records.setdefault(rec.record_name.decode(), {})
+            for rowmsg in rec.row_struct:
+                for c in rowmsg.record_int_list:
+                    cells[(c.row, c.col)] = int(c.data)
+                for c in rowmsg.record_float_list:
+                    cells[(c.row, c.col)] = float(c.data)
+
+    # ------------------------------------------------------------- gameplay
+    def move_to(self, x: float, y: float, z: float = 0.0) -> None:
+        self._send(
+            MsgID.REQ_MOVE,
+            ReqAckPlayerMove(
+                mover=self.player_guid,
+                target_pos=[Position(x=x, y=y, z=z)],
+            ),
+        )
+
+    def _on_move(self, base: MsgBase) -> None:
+        self.moves.append(ReqAckPlayerMove.decode(base.msg_data))
+
+    def chat(self, text: str) -> None:
+        self._send(
+            MsgID.REQ_CHAT,
+            ReqAckPlayerChat(chat_info=text.encode(), chat_type=0),
+        )
+
+    def _on_chat(self, base: MsgBase) -> None:
+        msg = ReqAckPlayerChat.decode(base.msg_data)
+        who = msg.chat_id
+        self.chat_log.append(
+            (f"{who.svrid}-{who.index}" if who else "?",
+             msg.chat_info.decode("utf-8", "replace"))
+        )
+
+    def use_skill(self, target: Ident, skill_id: str = "skill_1") -> None:
+        from ..net.wire import EffectData
+
+        self._send(
+            MsgID.REQ_SKILL_OBJECTX,
+            ReqAckUseSkill(
+                user=self.player_guid,
+                skill_id=skill_id.encode(),
+                effect_data=[EffectData(effect_ident=target)],
+            ),
+        )
+
+    def _on_skill(self, base: MsgBase) -> None:
+        self.skills.append(ReqAckUseSkill.decode(base.msg_data))
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None

@@ -1,0 +1,9 @@
+"""The five server roles (SURVEY §2.8) + the localhost cluster harness."""
+
+from .base import RoleConfig, ServerRole, load_server_xml  # noqa: F401
+from .cluster import LocalCluster  # noqa: F401
+from .game import GameRole  # noqa: F401
+from .login import LoginRole  # noqa: F401
+from .master import MasterRole  # noqa: F401
+from .proxy import ProxyRole  # noqa: F401
+from .world import WorldRole  # noqa: F401

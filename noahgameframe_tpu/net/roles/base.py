@@ -1,0 +1,180 @@
+"""Server-role scaffolding: config, registration, report plumbing.
+
+The reference deploys five process roles (Master/Login/World/Proxy/Game),
+each a `NFPluginLoader` instance whose net plugins read `Server.xml`
+(`_Out/NFDataCfg/Ini/NPC/Server.xml:3-8` — attributes ID/Type/IP/Port/
+MaxOnline/CpuCount/Name) and then keep the cluster wired by three
+mechanisms (SURVEY §3.5):
+
+- register on connect: client module sends `*_REGISTERED` with a
+  ServerInfoReportList describing itself;
+- refresh every 10 s: `*_REFRESH` + `STS_SERVER_REPORT` keepalives
+  (`NFINetClientModule.hpp:395-405`);
+- upstream fan-in: World relays game/proxy reports to Master
+  (`NFCWorldNet_ServerModule.cpp:36`), Master aggregates + serves JSON.
+
+`ServerRole` is the shared shell: one listening `NetServerModule`,
+any number of upstream `NetClientModule`s, a pump, and report helpers.
+Roles are pump-driven and single-threaded like the reference main loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+import xml.etree.ElementTree as ET
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..defines import MsgID, ServerState, ServerType
+from ..module import NetClientModule, NetServerModule
+from ..wire import Ident, MsgBase, ServerInfoReport, ServerInfoReportList, unwrap, wrap
+
+
+@dataclasses.dataclass
+class RoleConfig:
+    """One server instance's identity + endpoint (Server.xml row)."""
+
+    server_id: int
+    server_type: int
+    name: str = ""
+    ip: str = "127.0.0.1"
+    port: int = 0
+    max_online: int = 5000
+    cpu_count: int = 1
+    # upstream endpoints this role dials out to (master for login/world,
+    # world for proxy/game); filled from the cluster's Server.xml
+    targets: List["RoleConfig"] = dataclasses.field(default_factory=list)
+
+
+def load_server_xml(path: Path) -> List[RoleConfig]:
+    """Parse a reference-format Server.xml: <XML><Server ID=.. Type=..
+    IP=.. Port=.. MaxOnline=.. CpuCount=.. Name=../>...</XML>.
+
+    Type may be a ServerType name ("GAME") or its integer value."""
+    root = ET.parse(str(path)).getroot()
+    out: List[RoleConfig] = []
+    for node in root.findall("Server"):
+        t = node.get("Type", "0")
+        try:
+            server_type = int(t)
+        except ValueError:
+            server_type = int(ServerType[t.upper()])
+        out.append(
+            RoleConfig(
+                server_id=int(node.get("ID", "0")),
+                server_type=server_type,
+                name=node.get("Name", ""),
+                ip=node.get("IP", "127.0.0.1"),
+                port=int(node.get("Port", "0")),
+                max_online=int(node.get("MaxOnline", "5000")),
+                cpu_count=int(node.get("CpuCount", "1")),
+            )
+        )
+    return out
+
+
+class ServerRole:
+    """Base for the five roles: listening endpoint + upstream links."""
+
+    server_type: int = int(ServerType.NONE)
+
+    def __init__(self, config: RoleConfig, backend: str = "auto") -> None:
+        self.config = config
+        self.server = NetServerModule(config.ip, config.port, backend=backend)
+        config.port = self.server.port  # resolve ephemeral port
+        self.backend = backend
+        self.clients: Dict[str, NetClientModule] = {}
+        self.state = int(ServerState.NORMAL)
+        self._install()
+
+    # hook for subclasses to register handlers
+    def _install(self) -> None:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    # ---------------------------------------------------------- helpers
+    def add_upstream(self, key: str, targets: List[RoleConfig],
+                     register_msg: Optional[int] = None,
+                     refresh_msg: Optional[int] = None) -> NetClientModule:
+        """Create a client pool dialing `targets`; auto-send registration
+        on connect and refresh on the 10 s keepalive."""
+        pool = NetClientModule(backend=self.backend)
+        for t in targets:
+            pool.add_server(t.server_id, t.server_type, t.ip, t.port, t.name)
+        if register_msg is not None:
+            pool.on_connected(
+                lambda sid: pool.send_by_server_id(
+                    sid, int(register_msg), wrap(self.report_list())
+                )
+            )
+        if refresh_msg is not None:
+            pool.on_keepalive(
+                lambda: pool.send_to_all(int(refresh_msg), wrap(self.report_list()))
+            )
+        self.clients[key] = pool
+        return pool
+
+    def cur_count(self) -> int:
+        """Load metric reported upstream; roles override (players online,
+        connections, …)."""
+        return self.server.num_connections
+
+    def report(self) -> ServerInfoReport:
+        c = self.config
+        return ServerInfoReport(
+            server_id=c.server_id,
+            server_name=c.name.encode() if isinstance(c.name, str) else c.name,
+            server_ip=c.ip.encode(),
+            server_port=c.port,
+            server_max_online=c.max_online,
+            server_cur_count=self.cur_count(),
+            server_state=self.state,
+            server_type=self.server_type,
+        )
+
+    def report_list(self) -> ServerInfoReportList:
+        return ServerInfoReportList(server_list=[self.report()])
+
+    def ident(self) -> Ident:
+        return Ident(svrid=self.config.server_id, index=0)
+
+    # ---------------------------------------------------------- pump
+    def execute(self, now: Optional[float] = None) -> None:
+        now = _time.monotonic() if now is None else now
+        self.server.execute()
+        for pool in self.clients.values():
+            pool.execute(now)
+
+    def run(self, seconds: float, sleep: float = 0.001) -> None:
+        end = _time.monotonic() + seconds
+        while _time.monotonic() < end:
+            self.execute()
+            _time.sleep(sleep)
+
+    def shut(self) -> None:
+        self.server.shut()
+        for pool in self.clients.values():
+            pool.shut()
+
+
+def decode_reports(body: bytes) -> List[ServerInfoReport]:
+    """Unwrap a MsgBase-enveloped ServerInfoReportList."""
+    _, payload = unwrap(body, ServerInfoReportList)
+    return list(payload.server_list)
+
+
+def report_to_dict(r: ServerInfoReport) -> dict:
+    return {
+        "server_id": r.server_id,
+        "name": _s(r.server_name),
+        "ip": _s(r.server_ip),
+        "port": r.server_port,
+        "max_online": r.server_max_online,
+        "cur_count": r.server_cur_count,
+        "state": int(r.server_state),
+        "type": int(r.server_type),
+    }
+
+
+def _s(v) -> str:
+    return v.decode("utf-8", "replace") if isinstance(v, (bytes, bytearray)) else str(v)

@@ -1,0 +1,125 @@
+"""LocalCluster: the five-role topology assembled in one process.
+
+The reference's de-facto integration test is "start all five servers on
+localhost and watch the master dashboard go green"
+(`_Out/Tester/rund_*.sh`, SURVEY §4).  LocalCluster is that bring-up as a
+library call: every role on 127.0.0.1 ephemeral ports, all pumped from one
+loop — which also makes it the single-process simulation mode for tests
+and bots.  For a real multi-process deployment run one role per process
+via ``scripts/run_role.py`` with a shared Server.xml.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, List, Optional
+
+from ...game.world import GameWorld
+from ..defines import ServerType
+from .base import RoleConfig
+from .game import GameRole
+from .login import LoginRole
+from .master import MasterRole
+from .proxy import ProxyRole
+from .world import WorldRole
+
+
+class LocalCluster:
+    """Master + Login + World + Proxy + Game on localhost, one pump."""
+
+    def __init__(
+        self,
+        backend: str = "auto",
+        http_port: Optional[int] = None,
+        game_world: Optional[GameWorld] = None,
+        n_games: int = 1,
+        keepalive_seconds: float = 0.2,
+    ) -> None:
+        host = "127.0.0.1"
+        self.master = MasterRole(
+            RoleConfig(1, int(ServerType.MASTER), "Master1", host, 0),
+            backend=backend,
+            http_port=http_port,
+        )
+        master_t = [self.master.config]
+        self.world = WorldRole(
+            RoleConfig(7, int(ServerType.WORLD), "World1", host, 0,
+                       targets=master_t),
+            backend=backend,
+        )
+        world_t = [self.world.config]
+        self.login = LoginRole(
+            RoleConfig(4, int(ServerType.LOGIN), "Login1", host, 0,
+                       targets=master_t),
+            backend=backend,
+        )
+        self.proxy = ProxyRole(
+            RoleConfig(5, int(ServerType.PROXY), "Proxy1", host, 0,
+                       targets=world_t),
+            backend=backend,
+        )
+        self.games: List[GameRole] = []
+        for i in range(n_games):
+            self.games.append(
+                GameRole(
+                    RoleConfig(6 + i * 10, int(ServerType.GAME),
+                               f"Game{i + 1}", host, 0, targets=world_t),
+                    backend=backend,
+                    world=game_world if i == 0 else None,
+                )
+            )
+        self.game = self.games[0]
+        self.roles = [self.master, self.world, self.login, self.proxy, *self.games]
+        # speed up the registration/report cadence for in-process runs
+        for role in self.roles:
+            for pool in role.clients.values():
+                pool.keepalive_seconds = keepalive_seconds
+
+    # ------------------------------------------------------------- pump
+    def execute(self) -> None:
+        for role in self.roles:
+            role.execute()
+
+    def pump(self, extra: Callable[[], None] = None, rounds: int = 50,
+             sleep: float = 0.002) -> None:
+        """Drive everything for `rounds` iterations (plus an optional
+        client-side pump)."""
+        for _ in range(rounds):
+            self.execute()
+            if extra is not None:
+                extra()
+            _time.sleep(sleep)
+
+    def pump_until(self, cond: Callable[[], bool],
+                   extra: Callable[[], None] = None,
+                   timeout: float = 10.0, sleep: float = 0.002) -> bool:
+        end = _time.monotonic() + timeout
+        while _time.monotonic() < end:
+            self.execute()
+            if extra is not None:
+                extra()
+            if cond():
+                return True
+            _time.sleep(sleep)
+        return False
+
+    def wired(self) -> bool:
+        """True when the full topology is registered: world+login at
+        master, proxy+game at world, proxy has a live game link."""
+        reg = self.master.registry
+        return (
+            bool(reg.get(int(ServerType.WORLD)))
+            and bool(reg.get(int(ServerType.LOGIN)))
+            and len(self.world.proxies) > 0
+            and len(self.world.games) >= len(self.games)
+            and len(self.proxy.games.connected_servers()) >= len(self.games)
+        )
+
+    def start(self, timeout: float = 15.0) -> "LocalCluster":
+        if not self.pump_until(self.wired, timeout=timeout):
+            raise RuntimeError("cluster failed to wire up")
+        return self
+
+    def shut(self) -> None:
+        for role in self.roles:
+            role.shut()

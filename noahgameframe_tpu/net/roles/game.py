@@ -1,0 +1,595 @@
+"""Game role: the kernel-backed world server behind the proxy.
+
+Reference: NFGameServerNet_ServerPlugin + NFGameServerNet_ClientPlugin —
+accepts proxy connections and serves ~30 message handlers (enter/leave
+game, role CRUD, swap scene, move, chat;
+`NFCGameServerNet_ServerModule.cpp:31-73`), registers at World with 10 s
+reports (`NFCGameServerToWorldModule.cpp:34-130`), and binds the
+scene/AOI callbacks so property & record changes serialize into `NFMsg`
+sync messages sent via the proxy with explicit client lists
+(`OnPropertyEnter` `:271-400` and the §3.3 data-flow spine).
+
+TPU inversion: instead of per-write callbacks, the role pulls each tick's
+flag-masked diff masks off the device (already reduced by the jit'd step)
+and fans the changed cells out as grouped property-sync messages to every
+player in the broadcast set — one device fetch per bank per tick instead
+of one callback per write.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...core.datatypes import Bank, DataType, Guid
+from ...game.world import GameWorld, WorldConfig
+from ...kernel.kernel import ObjectEvent, TickOutputs
+from ..defines import EventCode, MsgID, ServerType
+from ..transport import EV_DISCONNECTED
+from ..wire import (
+    AckEventResult,
+    AckPlayerEntryList,
+    AckPlayerLeaveList,
+    AckRoleLiteInfoList,
+    Ident,
+    Message,
+    MsgBase,
+    ObjectPropertyFloat,
+    ObjectPropertyInt,
+    ObjectPropertyList,
+    ObjectRecordBase,
+    ObjectRecordList,
+    PlayerEntryInfo,
+    PropertyFloat,
+    PropertyInt,
+    PropertyString,
+    PropertyVector3,
+    RecordAddRowStruct,
+    RecordFloat,
+    RecordInt,
+    ReqAckPlayerChat,
+    ReqAckPlayerMove,
+    ReqAckSwapScene,
+    ReqAckUseSkill,
+    ReqCreateRole,
+    ReqDeleteRole,
+    ReqEnterGameServer,
+    ReqRoleList,
+    RoleLiteInfo,
+    Vector3,
+    ident_key as _ident_key,
+    unwrap,
+    wrap,
+)
+from .base import RoleConfig, ServerRole
+
+_IdentKey = Tuple[int, int]
+
+
+def guid_ident(g: Guid) -> Ident:
+    """GUID ↔ wire Ident (`NFMsgBase.proto` Ident{svrid,index})."""
+    return Ident(svrid=g.head, index=g.data)
+
+
+@dataclasses.dataclass
+class Session:
+    ident: Ident
+    conn_id: int  # proxy connection that owns this client
+    account: str = ""
+    guid: Optional[Guid] = None
+
+
+class GameRole(ServerRole):
+    server_type = int(ServerType.GAME)
+
+    def __init__(
+        self,
+        config: RoleConfig,
+        backend: str = "auto",
+        world: Optional[GameWorld] = None,
+        scene_id: int = 1,
+        sync_classes: Sequence[str] = ("Player", "NPC"),
+        skill_damage: int = 10,
+    ) -> None:
+        self.game_world = world if world is not None else GameWorld(
+            WorldConfig(combat=False, movement=False, regen=True)
+        ).start()
+        self.kernel = self.game_world.kernel
+        self.scene = self.game_world.scene
+        self.scene_id = scene_id
+        self.sync_classes = tuple(sync_classes)
+        self.skill_damage = skill_damage
+        if scene_id not in self.scene.scenes:
+            self.scene.create_scene(scene_id)
+        info = self.scene.scenes[scene_id]
+        if 1 not in info.groups:
+            self.scene.request_group(scene_id)
+        # sessions by client ident; reverse map guid -> ident key
+        self.sessions: Dict[_IdentKey, Session] = {}
+        self._guid_session: Dict[Guid, _IdentKey] = {}
+        # account -> role rows (in-memory until the persist agent binds in)
+        self.roles: Dict[str, List[RoleLiteInfo]] = {}
+        self._last_tick = 0.0
+        super().__init__(config, backend=backend)
+        self.world_link = self.add_upstream(
+            "world",
+            [t for t in config.targets if t.server_type == int(ServerType.WORLD)],
+            register_msg=MsgID.GTW_GAME_REGISTERED,
+            refresh_msg=MsgID.STS_SERVER_REPORT,
+        )
+        # a playable default stat table when the deployment didn't load one
+        # (reference ships Property*.xlsx configs; LevelModule refreshes the
+        # JOBLEVEL stat row from it on level-up)
+        pc = self.game_world.property_config
+        if not np.any(pc._base):
+            pc.fill_linear(
+                0,
+                base={"MAXHP": 100, "MAXMP": 50, "MAXSP": 50, "HPREGEN": 1,
+                      "ATK_VALUE": 10, "DEF_VALUE": 5, "MOVE_SPEED": 30000},
+                per_level={"MAXHP": 20, "ATK_VALUE": 2, "DEF_VALUE": 1},
+            )
+            pc.freeze()
+        self.kernel.register_class_event(self._on_class_event, "Player")
+        self.kernel.register_class_event(self._on_npc_event, "NPC")
+        # subscribe every public property of the synced classes; the kernel
+        # fires these for host writes synchronously AND from the device
+        # diff masks after each tick — one mechanism for the whole spine
+        self._changed: Dict[Tuple[str, str], np.ndarray] = {}
+        for cname in self.sync_classes:
+            spec = self.kernel.store.spec(cname)
+            for slot in spec.slots.values():
+                if slot.prop.public:
+                    self.kernel.register_property_event(
+                        cname, slot.prop.name, self._queue_change
+                    )
+
+    def _install(self) -> None:
+        s = self.server
+        s.on(MsgID.REQ_ROLE_LIST, self._on_role_list)
+        s.on(MsgID.REQ_CREATE_ROLE, self._on_create_role)
+        s.on(MsgID.REQ_DELETE_ROLE, self._on_delete_role)
+        s.on(MsgID.REQ_ENTER_GAME, self._on_enter_game)
+        s.on(MsgID.REQ_LEAVE_GAME, self._on_leave_game)
+        s.on(MsgID.REQ_SWAP_SCENE, self._on_swap_scene)
+        s.on(MsgID.REQ_MOVE, self._on_move)
+        s.on(MsgID.REQ_CHAT, self._on_chat)
+        s.on(MsgID.REQ_SKILL_OBJECTX, self._on_skill)
+        s.on_socket_event(self._on_socket)
+
+    def cur_count(self) -> int:
+        return len(self.sessions)
+
+    # ------------------------------------------------------------ sending
+    def _send_to(self, idents: Sequence[Ident], conn_id: int, msg_id: int,
+                 msg: Message) -> None:
+        self.server.send_raw(
+            conn_id, int(msg_id), wrap(msg, clients=list(idents))
+        )
+
+    def _send_to_session(self, sess: Session, msg_id: int, msg: Message) -> None:
+        self._send_to([sess.ident], sess.conn_id, msg_id, msg)
+
+    def _broadcast(self, target_guids: Sequence[Guid], msg_id: int,
+                   msg: Message, exclude: Optional[Guid] = None) -> None:
+        """Fan a message out to the sessions of `target_guids`, grouping
+        client idents per proxy connection (one envelope per proxy link —
+        the multicast list the reference's Transpond expands)."""
+        per_conn: Dict[int, List[Ident]] = {}
+        for g in target_guids:
+            if exclude is not None and g == exclude:
+                continue
+            key = self._guid_session.get(g)
+            if key is None:
+                continue
+            sess = self.sessions.get(key)
+            if sess is not None:
+                per_conn.setdefault(sess.conn_id, []).append(sess.ident)
+        for conn_id, idents in per_conn.items():
+            self._send_to(idents, conn_id, msg_id, msg)
+
+    def _scene_players(self, guid: Guid) -> List[Guid]:
+        return self.scene.broadcast_targets(guid, public=True)
+
+    # ------------------------------------------------------------ role CRUD
+    def _session_for(self, conn_id: int, base: MsgBase) -> Session:
+        key = _ident_key(base.player_id)
+        sess = self.sessions.get(key)
+        if sess is None:
+            sess = Session(ident=base.player_id or Ident(), conn_id=conn_id)
+            self.sessions[key] = sess
+        sess.conn_id = conn_id
+        return sess
+
+    def _on_role_list(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        base, req = unwrap(body, ReqRoleList)
+        sess = self._session_for(conn_id, base)
+        sess.account = req.account.decode("utf-8", "replace") or sess.account
+        ack = AckRoleLiteInfoList(char_data=self.roles.get(sess.account, []))
+        self._send_to_session(sess, MsgID.ACK_ROLE_LIST, ack)
+
+    def _on_create_role(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        base, req = unwrap(body, ReqCreateRole)
+        sess = self._session_for(conn_id, base)
+        account = req.account.decode("utf-8", "replace") or sess.account
+        sess.account = account
+        roles = self.roles.setdefault(account, [])
+        name = req.noob_name
+        if any(r.noob_name == name for r in roles):
+            code = int(EventCode.CHARACTER_EXIST)
+        else:
+            roles.append(
+                RoleLiteInfo(
+                    id=guid_ident(self.kernel.store.guids.next()),
+                    career=req.career,
+                    sex=req.sex,
+                    race=req.race,
+                    noob_name=name,
+                    game_id=req.game_id,
+                    role_level=1,
+                )
+            )
+            code = int(EventCode.SUCCESS)
+        self._send_to_session(
+            sess, MsgID.EVENT_RESULT, AckEventResult(event_code=code)
+        )
+        # the reference replies with the refreshed role list either way
+        ack = AckRoleLiteInfoList(char_data=roles)
+        self._send_to_session(sess, MsgID.ACK_ROLE_LIST, ack)
+
+    def _on_delete_role(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        base, req = unwrap(body, ReqDeleteRole)
+        sess = self._session_for(conn_id, base)
+        account = req.account.decode("utf-8", "replace") or sess.account
+        roles = self.roles.get(account, [])
+        self.roles[account] = [r for r in roles if r.noob_name != req.name]
+        self._send_to_session(
+            sess, MsgID.ACK_ROLE_LIST,
+            AckRoleLiteInfoList(char_data=self.roles[account]),
+        )
+
+    # ------------------------------------------------------------ enter/leave
+    def _on_enter_game(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        base, req = unwrap(body, ReqEnterGameServer)
+        sess = self._session_for(conn_id, base)
+        sess.account = req.account.decode("utf-8", "replace") or sess.account
+        if sess.guid is not None:
+            self._despawn(sess)  # re-entry replaces the old avatar
+        name = req.name.decode("utf-8", "replace")
+        guid = self.kernel.create_object(
+            "Player",
+            {"Name": name, "Account": sess.account, "GameID": self.config.server_id},
+            scene=0,
+            group=0,
+        )
+        sess.guid = guid
+        self._guid_session[guid] = _ident_key(sess.ident)
+        # level-1 stat init: JOBLEVEL row from config, recompute, refill
+        # (reference OnObjectLevelEvent → RefreshBaseProperty → full HP)
+        gw = self.game_world
+        self.kernel.set_property(guid, "Level", 1)
+        gw.properties.refresh_base_property(guid, gw.property_config)
+        gw.properties.recompute_now(guid)
+        gw.properties.full_hp_mp(guid)
+        gw.properties.full_sp(guid)
+        # enter-scene pipeline (RequestEnterScene semantics)
+        self.scene.enter_scene(guid, self.scene_id, 1)
+        ack = AckEventResult(
+            event_code=int(EventCode.ENTER_GAME_SUCCESS),
+            event_object=guid_ident(guid),
+        )
+        self._send_to_session(sess, MsgID.ACK_ENTER_GAME, ack)
+        self._send_snapshots(sess)
+
+    def _on_leave_game(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        base, _ = unwrap(body)
+        key = _ident_key(base.player_id)
+        sess = self.sessions.pop(key, None)
+        if sess is not None:
+            self._despawn(sess)
+
+    def _despawn(self, sess: Session) -> None:
+        if sess.guid is None:
+            return
+        guid = sess.guid
+        targets = self._scene_players(guid)
+        sess.guid = None
+        self._guid_session.pop(guid, None)
+        if guid in self.kernel.store.guid_map:
+            self.kernel.destroy_object(guid)
+        leave = AckPlayerLeaveList(object_list=[guid_ident(guid)])
+        self._broadcast(targets, MsgID.ACK_OBJECT_LEAVE, leave, exclude=guid)
+
+    def _on_socket(self, conn_id: int, kind: int) -> None:
+        if kind != EV_DISCONNECTED:
+            return
+        # a proxy link died: all its clients are gone
+        for key, sess in list(self.sessions.items()):
+            if sess.conn_id == conn_id:
+                self._despawn(sess)
+                self.sessions.pop(key, None)
+
+    # ------------------------------------------------------------ snapshots
+    def _entry_info(self, guid: Guid) -> PlayerEntryInfo:
+        k = self.kernel
+        cname, _ = k.store.row_of(guid)
+        pos = k.get_property(guid, "Position")
+        cfg = ""
+        if k.store.spec(cname).has_property("ConfigID"):
+            cfg = str(k.get_property(guid, "ConfigID"))
+        return PlayerEntryInfo(
+            object_guid=guid_ident(guid),
+            x=pos[0], y=pos[1], z=pos[2] if len(pos) > 2 else 0.0,
+            scene_id=int(k.get_property(guid, "SceneID")),
+            class_id=cname.encode(),
+            config_id=cfg.encode(),
+        )
+
+    def _property_list(self, guid: Guid, include_private: bool) -> ObjectPropertyList:
+        """Full property snapshot (OnPropertyEnter: Public to others,
+        Public+Private to self)."""
+        k = self.kernel
+        cname, row = k.store.row_of(guid)
+        spec = k.store.spec(cname)
+        cs = k.state.classes[cname]
+        out = ObjectPropertyList(player_id=guid_ident(guid))
+        banks = {Bank.I32: np.asarray(cs.i32[row]),
+                 Bank.F32: np.asarray(cs.f32[row]),
+                 Bank.VEC: np.asarray(cs.vec[row])}
+        for bank, rowvals in banks.items():
+            for slot in spec.bank_props(bank):
+                p = slot.prop
+                if not (p.public or (include_private and p.private)):
+                    continue
+                raw = rowvals[slot.col]
+                if p.type == DataType.INT:
+                    out.property_int_list.append(
+                        PropertyInt(property_name=p.name.encode(), data=int(raw)))
+                elif p.type == DataType.FLOAT:
+                    out.property_float_list.append(
+                        PropertyFloat(property_name=p.name.encode(), data=float(raw)))
+                elif p.type == DataType.STRING:
+                    s = k.store.strings.lookup(int(raw))
+                    out.property_string_list.append(
+                        PropertyString(property_name=p.name.encode(), data=s.encode()))
+                elif p.type in (DataType.VECTOR2, DataType.VECTOR3):
+                    out.property_vector3_list.append(
+                        PropertyVector3(
+                            property_name=p.name.encode(),
+                            data=Vector3(x=float(raw[0]), y=float(raw[1]),
+                                         z=float(raw[2])),
+                        ))
+        return out
+
+    def _record_list(self, guid: Guid, include_private: bool) -> ObjectRecordList:
+        """Record snapshot for the flag-visible records (OnRecordEnter)."""
+        k = self.kernel
+        cname, row = k.store.row_of(guid)
+        spec = k.store.spec(cname)
+        out = ObjectRecordList(player_id=guid_ident(guid))
+        for rname, rs in spec.records.items():
+            rdef = rs.rec
+            if not (rdef.public or (include_private and rdef.private)):
+                continue
+            rstate = k.state.classes[cname].records[rname]
+            used = np.asarray(rstate.used[row])
+            if not used.any():
+                continue
+            r_i32 = np.asarray(rstate.i32[row]) if rs.n_i32 else None
+            r_f32 = np.asarray(rstate.f32[row]) if rs.n_f32 else None
+            base = ObjectRecordBase(record_name=rname.encode())
+            for r_i in np.flatnonzero(used):
+                row_struct = RecordAddRowStruct(row=int(r_i))
+                for c_i, tag in enumerate(rs.col_order):
+                    cslot = rs.cols[tag]
+                    if cslot.bank == Bank.I32 and r_i32 is not None:
+                        row_struct.record_int_list.append(RecordInt(
+                            row=int(r_i), col=c_i,
+                            data=int(r_i32[int(r_i), cslot.col])))
+                    elif cslot.bank == Bank.F32 and r_f32 is not None:
+                        row_struct.record_float_list.append(RecordFloat(
+                            row=int(r_i), col=c_i,
+                            data=float(r_f32[int(r_i), cslot.col])))
+                base.row_struct.append(row_struct)
+            out.record_list.append(base)
+        return out
+
+    def _send_snapshots(self, sess: Session) -> None:
+        """Object-entry choreography toward the new client + the rest of
+        the group (OnObjectListEnter / OnPropertyEnter / OnRecordEnter)."""
+        guid = sess.guid
+        visible: List[Guid] = []
+        for cname in self.sync_classes:
+            visible.extend(
+                self.scene.objects_in_group(self.scene_id, 1, cname)
+            )
+        entry_all = AckPlayerEntryList(
+            object_list=[self._entry_info(g) for g in visible]
+        )
+        self._send_to_session(sess, MsgID.ACK_OBJECT_ENTRY, entry_all)
+        for g in visible:
+            self._send_to_session(
+                sess, MsgID.ACK_OBJECT_PROPERTY_ENTRY,
+                self._property_list(g, include_private=(g == guid)),
+            )
+        self._send_to_session(
+            sess, MsgID.ACK_OBJECT_RECORD_ENTRY,
+            self._record_list(guid, include_private=True),
+        )
+        # announce the newcomer to everyone already there
+        entry_self = AckPlayerEntryList(object_list=[self._entry_info(guid)])
+        others = self._scene_players(guid)
+        self._broadcast(others, MsgID.ACK_OBJECT_ENTRY, entry_self, exclude=guid)
+        self._broadcast(
+            others, MsgID.ACK_OBJECT_PROPERTY_ENTRY,
+            self._property_list(guid, include_private=False), exclude=guid,
+        )
+
+    # ------------------------------------------------------------ gameplay
+    def _on_swap_scene(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        base, req = unwrap(body, ReqAckSwapScene)
+        sess = self.sessions.get(_ident_key(base.player_id))
+        if sess is None or sess.guid is None:
+            return
+        scene_id = req.scene_id
+        if scene_id not in self.scene.scenes:
+            self.scene.create_scene(scene_id)
+        if 1 not in self.scene.scenes[scene_id].groups:
+            self.scene.request_group(scene_id)
+        self.scene.enter_scene(sess.guid, scene_id, 1)
+        self._send_to_session(sess, MsgID.ACK_SWAP_SCENE, req)
+
+    def _on_move(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        base, req = unwrap(body, ReqAckPlayerMove)
+        sess = self.sessions.get(_ident_key(base.player_id))
+        if sess is None or sess.guid is None or not req.target_pos:
+            return
+        p = req.target_pos[0]
+        self.kernel.set_property(sess.guid, "Position", (p.x, p.y, p.z))
+        req.mover = guid_ident(sess.guid)
+        self._broadcast(self._scene_players(sess.guid), MsgID.ACK_MOVE, req)
+
+    def _on_chat(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        base, req = unwrap(body, ReqAckPlayerChat)
+        sess = self.sessions.get(_ident_key(base.player_id))
+        if sess is None or sess.guid is None:
+            return
+        req.chat_id = guid_ident(sess.guid)
+        self._broadcast(self._scene_players(sess.guid), MsgID.ACK_CHAT, req)
+
+    def _on_skill(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        """Host-path skill resolution (`NFCSkillModule::OnUseSkill`
+        HP-damage semantics, `NFCSkillModule.cpp:74-160`); batch AoE lives
+        in game/combat.py on device."""
+        base, req = unwrap(body, ReqAckUseSkill)
+        sess = self.sessions.get(_ident_key(base.player_id))
+        if sess is None or sess.guid is None:
+            return
+        req.user = guid_ident(sess.guid)
+        for eff in req.effect_data:
+            target = self._guid_of_ident(eff.effect_ident)
+            if target is None or target not in self.kernel.store.guid_map:
+                continue
+            hp = int(self.kernel.get_property(target, "HP"))
+            dmg = self.skill_damage
+            self.kernel.set_property(target, "HP", max(0, hp - dmg))
+            eff.effect_value = dmg
+        self._broadcast(self._scene_players(sess.guid), MsgID.ACK_SKILL_OBJECTX, req)
+
+    def _guid_of_ident(self, ident: Optional[Ident]) -> Optional[Guid]:
+        if ident is None:
+            return None
+        return Guid(ident.svrid, ident.index)
+
+    # ------------------------------------------------------------ tick + sync
+    def execute(self, now: Optional[float] = None) -> None:
+        now = _time.monotonic() if now is None else now
+        super().execute(now)
+        pm = self.game_world.pm
+        if now - self._last_tick >= self.game_world.config.dt:
+            self._last_tick = now
+            for m in pm.modules.values():
+                if m is not self.kernel:
+                    m.execute()
+            self.kernel.execute()
+            self.kernel.tick()
+            pm.frame += 1
+        if self._changed:
+            if self.sessions:
+                self._flush_changes()
+            else:
+                self._changed.clear()
+
+    def _queue_change(self, cname: str, pname: str, rows: np.ndarray) -> None:
+        """Property-event sink: accumulate changed rows per (class, prop);
+        flushed once per frame (per-write callbacks → per-tick batch)."""
+        key = (cname, pname)
+        prev = self._changed.get(key)
+        self._changed[key] = (
+            rows.copy() if prev is None else np.union1d(prev, rows)
+        )
+
+    def _flush_changes(self) -> None:
+        """The batched §3.3 spine: changed cells → grouped property-sync
+        messages → proxy (client lists in the envelope)."""
+        k = self.kernel
+        changed, self._changed = self._changed, {}
+        # regroup per (class, row) so each entity sends one message per kind
+        per_entity: Dict[Tuple[str, int], List[str]] = {}
+        for (cname, pname), rows in changed.items():
+            for row in rows:
+                per_entity.setdefault((cname, int(row)), []).append(pname)
+        bank_cache: Dict[Tuple[str, str], np.ndarray] = {}
+
+        def bank_vals(cname: str, bank: Bank) -> np.ndarray:
+            key = (cname, bank.value)
+            if key not in bank_cache:
+                bank_cache[key] = np.asarray(
+                    getattr(k.state.classes[cname], bank.value)
+                )
+            return bank_cache[key]
+
+        for (cname, row), pnames in per_entity.items():
+            host = k.store._hosts[cname]
+            guid = host.row_guid[row] if row < len(host.row_guid) else None
+            if guid is None:
+                continue  # died since the change was queued
+            spec = k.store.spec(cname)
+            ints: List[PropertyInt] = []
+            floats: List[PropertyFloat] = []
+            strings: List[PropertyString] = []
+            vecs: List[PropertyVector3] = []
+            for pname in pnames:
+                slot = spec.slot(pname)
+                raw = bank_vals(cname, slot.bank)[row, slot.col]
+                p = slot.prop
+                if p.type == DataType.INT:
+                    ints.append(PropertyInt(
+                        property_name=p.name.encode(), data=int(raw)))
+                elif p.type == DataType.FLOAT:
+                    floats.append(PropertyFloat(
+                        property_name=p.name.encode(), data=float(raw)))
+                elif p.type == DataType.STRING:
+                    strings.append(PropertyString(
+                        property_name=p.name.encode(),
+                        data=k.store.strings.lookup(int(raw)).encode()))
+                else:
+                    vecs.append(PropertyVector3(
+                        property_name=p.name.encode(),
+                        data=Vector3(x=float(raw[0]), y=float(raw[1]),
+                                     z=float(raw[2]))))
+            targets = self._scene_players(guid)
+            pid = guid_ident(guid)
+            if ints:
+                self._broadcast(targets, MsgID.ACK_PROPERTY_INT,
+                                ObjectPropertyInt(player_id=pid,
+                                                  property_list=ints))
+            if floats:
+                self._broadcast(targets, MsgID.ACK_PROPERTY_FLOAT,
+                                ObjectPropertyFloat(player_id=pid,
+                                                    property_list=floats))
+            if strings:
+                self._broadcast(targets, MsgID.ACK_PROPERTY_STRING,
+                                ObjectPropertyList(player_id=pid,
+                                                   property_string_list=strings))
+            if vecs:
+                self._broadcast(targets, MsgID.ACK_PROPERTY_VECTOR3,
+                                ObjectPropertyList(player_id=pid,
+                                                   property_vector3_list=vecs))
+
+    # ------------------------------------------------------------ leave events
+    def _on_class_event(self, guid: Guid, _cname: str, ev: ObjectEvent) -> None:
+        if ev == ObjectEvent.DESTROY and guid in self._guid_session:
+            # destroyed outside _despawn (e.g. device death): clear binding
+            key = self._guid_session.pop(guid)
+            sess = self.sessions.get(key)
+            if sess is not None:
+                sess.guid = None
+
+    def _on_npc_event(self, guid: Guid, _cname: str, ev: ObjectEvent) -> None:
+        if ev == ObjectEvent.DESTROY and self.sessions:
+            leave = AckPlayerLeaveList(object_list=[guid_ident(guid)])
+            for sess in self.sessions.values():
+                self._send_to_session(sess, MsgID.ACK_OBJECT_LEAVE, leave)

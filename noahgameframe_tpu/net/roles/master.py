@@ -1,0 +1,202 @@
+"""Master role: cluster registry, rendezvous relay, status JSON + HTTP.
+
+Reference: NFMasterServerPlugin / NFMasterNet_ServerPlugin /
+NFMasterNet_HttpServerPlugin — handlers for world/login register+refresh
+and server reports upsert per-type `ServerData` maps
+(`NFCMasterNet_ServerModule.cpp:239-249,441-494`); the select-world
+handshake is relayed Login→Master→World→Master→Login (`:187-203`);
+`GetServersStatus` renders whole-cluster JSON served over evhttp
+(`:496-640`, `NFCMasterNet_HttpJsonModule.cpp:22-82`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import html
+import time as _time
+from typing import Dict, Optional
+
+from ..defines import MsgID, ServerState, ServerType
+from ..http import HttpServer
+from ..module import EV_DISCONNECTED
+from ..transport import EV_CONNECTED
+from ..wire import (
+    AckConnectWorldResult,
+    ReqConnectWorld,
+    ServerInfoReport,
+    ServerInfoReportList,
+    unwrap,
+    wrap,
+)
+from .base import RoleConfig, ServerRole, decode_reports, report_to_dict
+
+
+@dataclasses.dataclass
+class _Registered:
+    report: ServerInfoReport
+    conn_id: int = -1  # -1: known only via relayed report (no direct link)
+    last_seen: float = 0.0
+
+
+class MasterRole(ServerRole):
+    """The cluster brain: every other role registers here (directly or via
+    World relay) and the web monitor reads the aggregate."""
+
+    server_type = int(ServerType.MASTER)
+
+    def __init__(self, config: RoleConfig, backend: str = "auto",
+                 http_port: Optional[int] = None) -> None:
+        # per-type registries: type -> server_id -> _Registered
+        self.registry: Dict[int, Dict[int, _Registered]] = {}
+        self.http: Optional[HttpServer] = None
+        super().__init__(config, backend=backend)
+        if http_port is not None:
+            self.http = HttpServer(config.ip, http_port)
+            self.http.route("/json", lambda _p, _q: self.servers_status())
+            self.http.route("/", self._index_page)
+
+    def _install(self) -> None:
+        s = self.server
+        for msg in (MsgID.MTL_WORLD_REGISTERED, MsgID.MTL_WORLD_REFRESH):
+            s.on(msg, self._on_register(ServerType.WORLD))
+        s.on(MsgID.MTL_WORLD_UNREGISTERED, self._on_unregister)
+        for msg in (MsgID.LTM_LOGIN_REGISTERED, MsgID.LTM_LOGIN_REFRESH):
+            s.on(msg, self._on_register(ServerType.LOGIN))
+        s.on(MsgID.LTM_LOGIN_UNREGISTERED, self._on_unregister)
+        s.on(MsgID.STS_SERVER_REPORT, self._on_report)
+        s.on(MsgID.REQ_CONNECT_WORLD, self._on_req_connect_world)
+        s.on(MsgID.ACK_CONNECT_WORLD, self._on_ack_connect_world)
+        s.on_socket_event(self._on_socket)
+
+    # ------------------------------------------------------ registration
+    def _on_register(self, expect_type: ServerType):
+        def handler(conn_id: int, _msg_id: int, body: bytes) -> None:
+            for r in decode_reports(body):
+                self._upsert(r, conn_id)
+                self.server.conn_tags.setdefault(conn_id, {})["server_id"] = r.server_id
+            if expect_type == ServerType.WORLD:
+                self._push_world_list()
+            elif expect_type == ServerType.LOGIN:
+                self._send_world_list(conn_id)
+        return handler
+
+    def _on_unregister(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        for r in decode_reports(body):
+            self.registry.get(int(r.server_type), {}).pop(r.server_id, None)
+        self._push_world_list()
+
+    def _on_report(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        """Game/proxy reports relayed up by World (`OnServerReport`)."""
+        for r in decode_reports(body):
+            self._upsert(r, -1)
+
+    def _upsert(self, r: ServerInfoReport, conn_id: int) -> None:
+        by_id = self.registry.setdefault(int(r.server_type), {})
+        by_id[r.server_id] = _Registered(r, conn_id, _time.monotonic())
+
+    def _on_socket(self, conn_id: int, kind: int) -> None:
+        if kind != EV_DISCONNECTED:
+            return
+        # mark any server registered over this link as crashed
+        # (the reference flips EServerState on link loss)
+        for by_id in self.registry.values():
+            for reg in by_id.values():
+                if reg.conn_id == conn_id:
+                    reg.report.server_state = int(ServerState.CRASH)
+                    reg.conn_id = -1
+
+    # ------------------------------------------------ world list to logins
+    def _world_reports(self) -> ServerInfoReportList:
+        worlds = self.registry.get(int(ServerType.WORLD), {})
+        return ServerInfoReportList(
+            server_list=[reg.report for reg in worlds.values()]
+        )
+
+    def _send_world_list(self, conn_id: int) -> None:
+        self.server.send_raw(
+            conn_id, int(MsgID.STS_NET_INFO), wrap(self._world_reports())
+        )
+
+    def _push_world_list(self) -> None:
+        for conn_id, tags in self.server.conn_tags.items():
+            sid = tags.get("server_id")
+            if sid is None:
+                continue
+            logins = self.registry.get(int(ServerType.LOGIN), {})
+            if sid in logins and logins[sid].conn_id == conn_id:
+                self._send_world_list(conn_id)
+
+    # ------------------------------------------------ select-world relay
+    def _conn_of(self, server_type: ServerType, server_id: int) -> int:
+        reg = self.registry.get(int(server_type), {}).get(server_id)
+        return reg.conn_id if reg is not None else -1
+
+    def _on_req_connect_world(self, conn_id: int, msg_id: int, body: bytes) -> None:
+        """Login asks for a world slot → relay to that world
+        (`OnSelectWorldProcess` `NFCMasterNet_ServerModule.cpp:187-203`)."""
+        _, req = unwrap(body, ReqConnectWorld)
+        target = self._conn_of(ServerType.WORLD, req.world_id)
+        if target >= 0:
+            self.server.send_raw(target, msg_id, body)
+
+    def _on_ack_connect_world(self, conn_id: int, msg_id: int, body: bytes) -> None:
+        """World answers with proxy endpoint + key → relay to the asking
+        login (`OnSelectWorldResultsProcess`)."""
+        _, ack = unwrap(body, AckConnectWorldResult)
+        target = self._conn_of(ServerType.LOGIN, ack.login_id)
+        if target >= 0:
+            self.server.send_raw(target, msg_id, body)
+
+    # ------------------------------------------------------ status JSON
+    def servers_status(self) -> dict:
+        """Whole-cluster aggregate (`GetServersStatus` JSON)."""
+        out: Dict[str, list] = {}
+        for stype, by_id in sorted(self.registry.items()):
+            try:
+                key = ServerType(stype).name.lower()
+            except ValueError:
+                key = str(stype)
+            out[key] = [
+                report_to_dict(reg.report) for _, reg in sorted(by_id.items())
+            ]
+        return {
+            "master": report_to_dict(self.report()),
+            "servers": out,
+        }
+
+    def _index_page(self, _path: str, _params: Dict[str, str]) -> str:
+        """Tiny built-in dashboard (Tool/NF_Web_Monitor equivalent)."""
+        rows = []
+        for group, servers in self.servers_status()["servers"].items():
+            for s in servers:
+                try:
+                    state = ServerState(s["state"]).name
+                except ValueError:
+                    state = str(s["state"])
+                name = html.escape(str(s['name']))
+                endpoint = html.escape(f"{s['ip']}:{s['port']}")
+                rows.append(
+                    f"<tr><td>{html.escape(group)}</td><td>{s['server_id']}</td>"
+                    f"<td>{name}</td><td>{endpoint}</td>"
+                    f"<td>{s['cur_count']}/{s['max_online']}</td>"
+                    f"<td>{html.escape(str(state))}</td></tr>"
+                )
+        return (
+            "<html><head><title>cluster status</title></head><body>"
+            "<h2>Cluster status</h2>"
+            "<table border=1 cellpadding=4><tr><th>role</th><th>id</th>"
+            "<th>name</th><th>endpoint</th><th>load</th><th>state</th></tr>"
+            + "".join(rows)
+            + "</table><p><a href='/json'>raw json</a></p></body></html>"
+        )
+
+    # ------------------------------------------------------------ pump
+    def execute(self, now: Optional[float] = None) -> None:
+        super().execute(now)
+        if self.http is not None:
+            self.http.execute()
+
+    def shut(self) -> None:
+        super().shut()
+        if self.http is not None:
+            self.http.close()

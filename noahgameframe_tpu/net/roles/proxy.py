@@ -1,0 +1,198 @@
+"""Proxy (gateway) role: client TCP edge, auth by connect key, routing.
+
+Reference: NFProxyServerNet_ServerPlugin / NFProxyServerNet_ClientPlugin —
+clients attach here after the select-world handshake; `OnConnectKeyProcess`
+verifies the world-minted key and binds the account to the connection
+(`NFCProxyServerNet_ServerModule.cpp:130-163`); every further client
+message is stamped with the verified client ident and routed client→game
+by selected server id or consistent hash (`OnOtherMessage` `:83-128`);
+game→client traffic is fanned out per the envelope's client list
+(`Transpond` `:297-352`, which forwards the *inner* payload to each
+client).  The proxy learns the live game-server set from World
+(STS_NET_INFO) and keeps an outbound pool with the reconnect FSM.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from ..defines import EventCode, MsgID, ServerType
+from ..module import NORMAL, NetClientModule
+from ..transport import EV_DISCONNECTED
+from ..wire import (
+    AckConnectWorldResult,
+    AckEventResult,
+    Ident,
+    MsgBase,
+    ReqAccountLogin,
+    ReqSelectServer,
+    ident_key as _ident_key,
+    unwrap,
+    wrap,
+)
+from .base import RoleConfig, ServerRole, decode_reports
+
+_IdentKey = Tuple[int, int]  # (svrid, index)
+
+
+class ProxyRole(ServerRole):
+    server_type = int(ServerType.PROXY)
+
+    def __init__(self, config: RoleConfig, backend: str = "auto") -> None:
+        # account -> world-minted connect key, pre-authorized by World
+        self._keys: Dict[str, str] = {}
+        # verified client ident -> conn_id (the Transpond routing table)
+        self._client_conn: Dict[_IdentKey, int] = {}
+        # conn_id -> binding info, survives until the disconnect handler has
+        # told the game (conn_tags are cleared before our socket hook runs)
+        self._conn_info: Dict[int, Dict[str, object]] = {}
+        super().__init__(config, backend=backend)
+        self.world = self.add_upstream(
+            "world",
+            [t for t in config.targets if t.server_type == int(ServerType.WORLD)],
+            register_msg=MsgID.PTWG_PROXY_REGISTERED,
+            refresh_msg=MsgID.PTWG_PROXY_REFRESH,
+        )
+        self.world.on(MsgID.ACK_CONNECT_KEY, self._on_key_granted)
+        self.world.on(MsgID.STS_NET_INFO, self._on_game_list)
+        # outbound pool to game servers (fed by World's game list)
+        self.games = NetClientModule(backend=self.backend)
+        self.clients["games"] = self.games
+        self.games.on_any(self._transpond)
+
+    def _install(self) -> None:
+        s = self.server
+        s.on(MsgID.REQ_CONNECT_KEY, self._on_connect_key)
+        s.on(MsgID.REQ_SELECT_SERVER, self._on_select_server)
+        s.on_any(self._on_client_message)
+        s.on_socket_event(self._on_socket)
+
+    def cur_count(self) -> int:
+        return len(self._client_conn)
+
+    # ------------------------------------------------------ world side
+    def _on_key_granted(self, _sid: int, _msg_id: int, body: bytes) -> None:
+        _, grant = unwrap(body, AckConnectWorldResult)
+        self._keys[grant.account.decode("utf-8", "replace")] = grant.world_key.decode(
+            "utf-8", "replace"
+        )
+
+    def _on_game_list(self, _sid: int, _msg_id: int, body: bytes) -> None:
+        """Reconcile the outbound pool against World's authoritative game
+        list: add new, re-dial changed endpoints, prune vanished servers
+        (a restarted game comes back on a new ephemeral port)."""
+        seen = set()
+        for r in decode_reports(body):
+            sid = r.server_id
+            ip = r.server_ip.decode("utf-8", "replace")
+            seen.add(sid)
+            sd = self.games.servers.get(sid)
+            if sd is not None and (sd.ip != ip or sd.port != r.server_port):
+                self.games.remove_server(sid)
+                sd = None
+            if sd is None:
+                self.games.add_server(
+                    sid, int(r.server_type), ip, r.server_port,
+                    r.server_name.decode("utf-8", "replace"),
+                )
+        for sid in list(self.games.servers):
+            if sid not in seen:
+                self.games.remove_server(sid)
+
+    # ------------------------------------------------------ client side
+    def _on_connect_key(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        _, req = unwrap(body, ReqAccountLogin)
+        account = req.account.decode("utf-8", "replace")
+        key = req.security_code.decode("utf-8", "replace")
+        ok = account and self._keys.get(account) == key
+        if ok:
+            ident = Ident(svrid=self.config.server_id, index=conn_id)
+            tags = self.server.conn_tags.setdefault(conn_id, {})
+            tags["account"] = account
+            tags["ident"] = ident
+            self._client_conn[_ident_key(ident)] = conn_id
+            self._conn_info[conn_id] = {"ident": ident, "account": account}
+            ack = AckEventResult(
+                event_code=int(EventCode.VERIFY_KEY_SUCCESS), event_object=ident
+            )
+        else:
+            ack = AckEventResult(event_code=int(EventCode.VERIFY_KEY_FAIL))
+        self.server.send_pb(conn_id, int(MsgID.ACK_CONNECT_KEY), ack)
+        if not ok:
+            self.server.close_conn(conn_id)
+
+    def _on_select_server(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        """Bind this client to a specific game server
+        (`OnReqServerListProcess`/select path)."""
+        tags = self.server.conn_tags.get(conn_id, {})
+        if "ident" not in tags:
+            return
+        _, req = unwrap(body, ReqSelectServer)
+        sd = self.games.servers.get(req.world_id)
+        if sd is not None and sd.state == NORMAL:
+            tags["game_id"] = req.world_id
+            info = self._conn_info.get(conn_id)
+            if info is not None:
+                info["game_id"] = req.world_id
+            code = int(EventCode.SELECTSERVER_SUCCESS)
+        else:
+            code = int(EventCode.SELECTSERVER_FAIL)
+        self.server.send_pb(
+            conn_id,
+            int(MsgID.ACK_SELECT_SERVER),
+            AckEventResult(event_code=code),
+        )
+
+    def _on_client_message(self, conn_id: int, msg_id: int, body: bytes) -> None:
+        """The routing hot path: stamp the verified ident, forward to the
+        bound game server or hash-route by account."""
+        tags = self.server.conn_tags.get(conn_id, {})
+        ident = tags.get("ident")
+        if ident is None:
+            return  # unauthenticated: drop (reference closes after abuse)
+        base = MsgBase.decode(body)
+        base.player_id = ident  # server-authoritative identity stamp
+        out = base.encode()
+        game_id = tags.get("game_id")
+        if game_id is not None:
+            self.games.send_by_server_id(game_id, msg_id, out)
+        else:
+            self.games.send_by_suit(tags.get("account", ""), msg_id, out)
+
+    def _on_socket(self, conn_id: int, kind: int) -> None:
+        if kind != EV_DISCONNECTED:
+            return
+        self._client_conn = {
+            k: c for k, c in self._client_conn.items() if c != conn_id
+        }
+        # tell the game its player is gone (the reference proxy fires
+        # REQ_LEAVE_GAME upstream when a client socket dies)
+        info = self._conn_info.pop(conn_id, None)
+        if info is None:
+            return
+        base = MsgBase(player_id=info["ident"], msg_data=b"")
+        game_id = info.get("game_id")
+        if game_id is not None:
+            self.games.send_by_server_id(
+                int(game_id), int(MsgID.REQ_LEAVE_GAME), base.encode()
+            )
+        else:
+            self.games.send_by_suit(
+                str(info.get("account", "")), int(MsgID.REQ_LEAVE_GAME),
+                base.encode(),
+            )
+
+    # ------------------------------------------------------ game → client
+    def _transpond(self, _sid: int, msg_id: int, body: bytes) -> None:
+        """Deliver the enveloped message to each client in the envelope's
+        client list (empty list → the envelope's player_id).  The whole
+        MsgBase goes through unchanged, exactly like the reference's
+        `SendMsgWithOutHead(nMsgID, msg, nLen)` — clients always unwrap."""
+        base = MsgBase.decode(body)
+        targets = base.player_client_list or (
+            [base.player_id] if base.player_id is not None else []
+        )
+        for ident in targets:
+            conn_id = self._client_conn.get(_ident_key(ident))
+            if conn_id is not None:
+                self.server.send_raw(conn_id, msg_id, body)

@@ -1,0 +1,177 @@
+"""World role: hub for game/proxy registration + enter-world rendezvous.
+
+Reference: NFWorldNet_ServerPlugin / NFWorldLogicPlugin — game and proxy
+servers register and refresh here (callbacks
+`NFCWorldNet_ServerModule.cpp:28-36`); on a select-world request the world
+picks the least-loaded proxy, mints a connect key, pre-authorizes it at
+that proxy, and answers Master with the proxy endpoint + key; server
+reports from games/proxies are relayed up to Master (SURVEY §3.5).  It
+also pushes the live game-server list down to proxies so the gateway can
+keep its outbound pool current.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import time as _time
+from typing import Dict, List, Optional
+
+from ..defines import MsgID, ServerType
+from ..transport import EV_DISCONNECTED
+from ..wire import (
+    AckConnectWorldResult,
+    ReqConnectWorld,
+    ServerInfoReport,
+    ServerInfoReportList,
+    unwrap,
+    wrap,
+)
+from .base import RoleConfig, ServerRole, decode_reports
+
+
+@dataclasses.dataclass
+class _Downstream:
+    report: ServerInfoReport
+    conn_id: int
+    last_seen: float = 0.0
+
+
+class WorldRole(ServerRole):
+    server_type = int(ServerType.WORLD)
+
+    def __init__(self, config: RoleConfig, backend: str = "auto") -> None:
+        self.games: Dict[int, _Downstream] = {}
+        self.proxies: Dict[int, _Downstream] = {}
+        super().__init__(config, backend=backend)
+        self.master = self.add_upstream(
+            "master",
+            [t for t in config.targets if t.server_type == int(ServerType.MASTER)],
+            register_msg=MsgID.MTL_WORLD_REGISTERED,
+            refresh_msg=MsgID.MTL_WORLD_REFRESH,
+        )
+        self.master.on(MsgID.REQ_CONNECT_WORLD, self._on_req_connect_world)
+
+    def _install(self) -> None:
+        s = self.server
+        for msg in (MsgID.GTW_GAME_REGISTERED, MsgID.GTW_GAME_REFRESH):
+            s.on(msg, self._on_game_register)
+        s.on(MsgID.GTW_GAME_UNREGISTERED, self._on_game_unregister)
+        for msg in (MsgID.PTWG_PROXY_REGISTERED, MsgID.PTWG_PROXY_REFRESH):
+            s.on(msg, self._on_proxy_register)
+        s.on(MsgID.PTWG_PROXY_UNREGISTERED, self._on_proxy_unregister)
+        s.on(MsgID.STS_SERVER_REPORT, self._on_server_report)
+        s.on_socket_event(self._on_socket)
+
+    # ---------------------------------------------------- registration
+    def _on_game_register(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        for r in decode_reports(body):
+            self.games[r.server_id] = _Downstream(r, conn_id, _time.monotonic())
+            self.server.conn_tags.setdefault(conn_id, {})["server_id"] = r.server_id
+            self._relay_report(r)
+        self._push_game_list()
+
+    def _on_game_unregister(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        for r in decode_reports(body):
+            self.games.pop(r.server_id, None)
+        self._push_game_list()
+
+    def _on_proxy_register(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        for r in decode_reports(body):
+            self.proxies[r.server_id] = _Downstream(r, conn_id, _time.monotonic())
+            self.server.conn_tags.setdefault(conn_id, {})["server_id"] = r.server_id
+            self._relay_report(r)
+        # a (re)joined proxy needs the current game list immediately
+        self._send_game_list(conn_id)
+
+    def _on_proxy_unregister(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        for r in decode_reports(body):
+            self.proxies.pop(r.server_id, None)
+
+    def _on_server_report(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        """Keepalive load reports from games/proxies; refresh + relay up
+        (`NFCWorldNet_ServerModule.cpp:36` → Master upsert)."""
+        now = _time.monotonic()
+        for r in decode_reports(body):
+            book = self.games if r.server_type == int(ServerType.GAME) else self.proxies
+            if r.server_id in book:
+                book[r.server_id].report = r
+                book[r.server_id].last_seen = now
+            self._relay_report(r)
+
+    def _relay_report(self, r: ServerInfoReport) -> None:
+        self.master.send_to_all(
+            int(MsgID.STS_SERVER_REPORT),
+            wrap(ServerInfoReportList(server_list=[r])),
+        )
+
+    def _on_socket(self, conn_id: int, kind: int) -> None:
+        if kind != EV_DISCONNECTED:
+            return
+        from ..defines import ServerState
+
+        dead = [v for v in list(self.games.values()) + list(self.proxies.values())
+                if v.conn_id == conn_id]
+        self.games = {k: v for k, v in self.games.items() if v.conn_id != conn_id}
+        self.proxies = {k: v for k, v in self.proxies.items() if v.conn_id != conn_id}
+        if not dead:
+            return
+        # unplanned death: tell Master (CRASH state) and re-push the game
+        # list so proxies stop routing to the corpse
+        for d in dead:
+            d.report.server_state = int(ServerState.CRASH)
+            self._relay_report(d.report)
+        self._push_game_list()
+
+    # ---------------------------------------------- game list to proxies
+    def _game_reports(self) -> ServerInfoReportList:
+        return ServerInfoReportList(
+            server_list=[d.report for d in self.games.values()]
+        )
+
+    def _send_game_list(self, conn_id: int) -> None:
+        self.server.send_raw(
+            conn_id, int(MsgID.STS_NET_INFO), wrap(self._game_reports())
+        )
+
+    def _push_game_list(self) -> None:
+        for d in self.proxies.values():
+            self._send_game_list(d.conn_id)
+
+    # -------------------------------------------------- enter-world path
+    def _pick_proxy(self) -> Optional[_Downstream]:
+        """Least-loaded live proxy (`NFCWorldNet_ServerModule` picks by
+        current count)."""
+        best = None
+        for d in self.proxies.values():
+            if best is None or d.report.server_cur_count < best.report.server_cur_count:
+                best = d
+        return best
+
+    def _mint_key(self, account: str) -> str:
+        return hashlib.sha1(
+            account.encode() + os.urandom(16)
+        ).hexdigest()[:32]
+
+    def _on_req_connect_world(self, _sid: int, _msg_id: int, body: bytes) -> None:
+        _, req = unwrap(body, ReqConnectWorld)
+        account = req.account.decode("utf-8", "replace")
+        proxy = self._pick_proxy()
+        if proxy is None:
+            return
+        key = self._mint_key(account)
+        grant = AckConnectWorldResult(
+            world_id=self.config.server_id,
+            sender=req.sender,
+            login_id=req.login_id,
+            account=account.encode(),
+            world_ip=proxy.report.server_ip,
+            world_port=proxy.report.server_port,
+            world_key=key.encode(),
+        )
+        # pre-authorize the key at the chosen proxy, then answer Master
+        self.server.send_raw(
+            proxy.conn_id, int(MsgID.ACK_CONNECT_KEY), wrap(grant)
+        )
+        self.master.send_to_all(int(MsgID.ACK_CONNECT_WORLD), wrap(grant))

@@ -229,6 +229,11 @@ class Ident(Message):
     FIELDS = [(1, "svrid", "int64", 0), (2, "index", "int64", 0)]
 
 
+def ident_key(i: Optional["Ident"]) -> Tuple[int, int]:
+    """Hashable identity of a wire Ident (routing-table key)."""
+    return (i.svrid, i.index) if i is not None else (0, 0)
+
+
 class Vector2(Message):
     FIELDS = [(1, "x", "float", 0.0), (2, "y", "float", 0.0)]
 

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Run one server role as a standalone process (NFPluginLoader equivalent).
+
+The reference launches each role as `NFPluginLoader Server=GameServer ID=6`
+reading Server.xml (`_Out/Tester/rund_*.sh`); here:
+
+    python scripts/run_role.py --role master --id 1 --server-xml cluster.xml
+    python scripts/run_role.py --role game --id 6 --server-xml cluster.xml
+
+Server.xml lists every instance in the cluster; each process picks its own
+row by (role, id) and derives its upstream targets from the others
+(login/world dial the master; proxy/game dial the world).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from noahgameframe_tpu.net.defines import ServerType  # noqa: E402
+from noahgameframe_tpu.net.roles import (  # noqa: E402
+    GameRole,
+    LoginRole,
+    MasterRole,
+    ProxyRole,
+    WorldRole,
+    load_server_xml,
+)
+
+ROLE_CLASSES = {
+    "master": (MasterRole, int(ServerType.MASTER), None),
+    "login": (LoginRole, int(ServerType.LOGIN), int(ServerType.MASTER)),
+    "world": (WorldRole, int(ServerType.WORLD), int(ServerType.MASTER)),
+    "proxy": (ProxyRole, int(ServerType.PROXY), int(ServerType.WORLD)),
+    "game": (GameRole, int(ServerType.GAME), int(ServerType.WORLD)),
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", required=True, choices=sorted(ROLE_CLASSES))
+    ap.add_argument("--id", type=int, required=True, help="server id in Server.xml")
+    ap.add_argument("--server-xml", required=True, type=Path)
+    ap.add_argument("--http-port", type=int, default=None,
+                    help="master only: status HTTP port")
+    ap.add_argument("--tick-sleep", type=float, default=0.001,
+                    help="main-loop sleep (reference: 1 ms)")
+    args = ap.parse_args()
+
+    cls, stype, upstream_type = ROLE_CLASSES[args.role]
+    rows = load_server_xml(args.server_xml)
+    mine = [r for r in rows if r.server_type == stype and r.server_id == args.id]
+    if not mine:
+        print(f"no <Server> row with Type={args.role} ID={args.id}", file=sys.stderr)
+        return 2
+    config = mine[0]
+    if upstream_type is not None:
+        config.targets = [r for r in rows if r.server_type == upstream_type]
+
+    kwargs = {}
+    if args.role == "master" and args.http_port is not None:
+        kwargs["http_port"] = args.http_port
+    role = cls(config, **kwargs)
+    print(f"{args.role} id={config.server_id} listening on "
+          f"{config.ip}:{config.port}", flush=True)
+    try:
+        while True:
+            role.execute()
+            time.sleep(args.tick_sleep)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        role.shut()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

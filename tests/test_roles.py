@@ -1,0 +1,186 @@
+"""Five-role cluster integration: registration, login→enter-game pipeline,
+property sync, transpond multicast, HTTP monitor (SURVEY §3.4, §3.5)."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from noahgameframe_tpu.client import GameClient
+from noahgameframe_tpu.game.world import GameWorld, WorldConfig
+from noahgameframe_tpu.net.defines import ServerType
+from noahgameframe_tpu.net.roles import LocalCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    gw = GameWorld(WorldConfig(combat=False, movement=False, regen=True,
+                               npc_capacity=64, player_capacity=16)).start()
+    c = LocalCluster(http_port=0, game_world=gw)
+    # resolve actual http port
+    c.start(timeout=20.0)
+    yield c
+    c.shut()
+
+
+def drive_client(cluster, client, cond, timeout=10.0):
+    ok = cluster.pump_until(cond, extra=client.execute, timeout=timeout)
+    assert ok, f"timeout waiting for {cond}"
+
+
+def full_login(cluster, account: str, name: str) -> GameClient:
+    c = GameClient(account)
+    c.connect("127.0.0.1", cluster.login.config.port)
+    drive_client(cluster, c, lambda: c.connected)
+    c.login()
+    drive_client(cluster, c, lambda: c.logged_in)
+    c.request_world_list()
+    drive_client(cluster, c, lambda: c.worlds)
+    c.connect_world(c.worlds[0].server_id)
+    drive_client(cluster, c, lambda: c.world_grant is not None)
+    c.connect_proxy()
+    drive_client(cluster, c, lambda: c.connected)
+    c.verify_key()
+    drive_client(cluster, c, lambda: c.key_verified)
+    c.select_server(cluster.game.config.server_id)
+    drive_client(cluster, c, lambda: c.server_selected)
+    c.create_role(name)
+    drive_client(cluster, c, lambda: c.roles)
+    c.enter_game(name)
+    drive_client(cluster, c, lambda: c.entered)
+    return c
+
+
+def test_cluster_wires_up(cluster):
+    status = cluster.master.servers_status()
+    assert status["servers"]["world"]
+    assert status["servers"]["login"]
+    # game + proxy reports relayed up through world
+    assert status["servers"]["game"]
+    assert status["servers"]["proxy"]
+
+
+def test_http_monitor(cluster):
+    import threading
+
+    port = cluster.master.http.port
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            cluster.execute()
+            time.sleep(0.002)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/json", timeout=5
+        ) as r:
+            data = json.loads(r.read())
+        assert data["master"]["server_id"] == 1
+        assert data["servers"]["world"][0]["server_id"] == 7
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=5) as r:
+            assert b"Cluster status" in r.read()
+    finally:
+        stop.set()
+        t.join(timeout=2)
+
+
+def test_login_enter_game_pipeline(cluster):
+    c = full_login(cluster, "alice", "Alice")
+    assert c.player_ident is not None
+    assert c.player_guid is not None
+    # avatar exists server-side in scene 1 group 1
+    game = cluster.game
+    players = game.scene.objects_in_group(1, 1, "Player")
+    assert len(players) >= 1
+    # snapshot arrived: own object in mirror with public+private properties
+    me = c.objects.get((c.player_guid.svrid, c.player_guid.index))
+    assert me is not None
+    assert me.properties.get("Name") == "Alice"
+    assert "HP" in me.properties
+    c.close()
+    drive_client(cluster, c, lambda: not any(
+        s.guid is not None and s.account == "alice"
+        for s in game.sessions.values()
+    ))
+
+
+def test_two_clients_see_each_other_and_sync(cluster):
+    a = full_login(cluster, "bob", "Bob")
+    b = full_login(cluster, "carol", "Carol")
+
+    class _Both:
+        def execute(self):
+            a.execute()
+            b.execute()
+
+    both = _Both()
+    # b's entry must reach a (broadcast on enter)
+    drive_client(
+        cluster, both,
+        lambda: (b.player_guid.svrid, b.player_guid.index) in a.objects,
+    )
+    # move: a moves, b sees ACK_MOVE multicast + the diff-stream position
+    a.move_to(10.0, 20.0, 0.0)
+    drive_client(cluster, both, lambda: b.moves)
+    mv = b.moves[-1]
+    assert mv.target_pos and abs(mv.target_pos[0].x - 10.0) < 1e-5
+    # property diff stream: the Position change lands in b's mirror
+    drive_client(
+        cluster, both,
+        lambda: b.objects.get(
+            (a.player_guid.svrid, a.player_guid.index)
+        ) is not None
+        and b.objects[(a.player_guid.svrid, a.player_guid.index)]
+        .properties.get("Position", (0, 0, 0))[0] == pytest.approx(10.0),
+        timeout=15.0,
+    )
+    # chat broadcast
+    a.chat("hello")
+    drive_client(cluster, both, lambda: b.chat_log)
+    assert b.chat_log[-1][1] == "hello"
+    # skill: a hits b → b's HP drops by 10 server-side and in the mirror
+    hp0 = int(cluster.game.kernel.get_property(
+        _guid_of(b), "HP"))
+    a.use_skill(b.player_guid)
+    drive_client(cluster, both, lambda: b.skills)
+    hp1 = int(cluster.game.kernel.get_property(_guid_of(b), "HP"))
+    assert hp1 == hp0 - 10
+    a.close()
+    b.close()
+
+
+def _guid_of(client):
+    from noahgameframe_tpu.core.datatypes import Guid
+
+    return Guid(client.player_guid.svrid, client.player_guid.index)
+
+
+def test_unauthed_proxy_messages_dropped(cluster):
+    c = GameClient("mallory")
+    c.connect("127.0.0.1", cluster.proxy.config.port)
+    drive_client(cluster, c, lambda: c.connected)
+    # no connect key: role list must never arrive
+    c.request_role_list()
+    cluster.pump(extra=c.execute, rounds=30)
+    assert not c.roles
+    c.close()
+
+
+def test_wrong_connect_key_rejected(cluster):
+    c = GameClient("eve")
+    c.connect("127.0.0.1", cluster.proxy.config.port)
+    drive_client(cluster, c, lambda: c.connected)
+    from noahgameframe_tpu.net.wire import AckConnectWorldResult
+
+    c.world_grant = AckConnectWorldResult(world_key=b"bogus")
+    c.verify_key()
+    # proxy answers VERIFY_KEY_FAIL and closes the connection
+    drive_client(cluster, c, lambda: not c.connected)
+    assert not c.key_verified
+    c.close()
