@@ -494,6 +494,31 @@ class EntityStore:
         rec = rec.replace(used=rec.used.at[row, r].set(True))
         return with_class(state, class_name, cs.replace(records={**cs.records, record_name: rec})), r
 
+    def record_restore_row(
+        self,
+        state: WorldState,
+        guid: Guid,
+        record_name: str,
+        rec_row: int,
+        row_values: Dict[str, Value],
+    ) -> WorldState:
+        """Write a row at an exact index and mark it used — the
+        persistence/load path, which must preserve row indices (the
+        reference's protobuf record blobs are row-addressed)."""
+        class_name, row = self.row_of(guid)
+        rs = self._rec(class_name, record_name)
+        full: Dict[str, Value] = {
+            tag: default_value(rs.cols[tag].col_def.type) for tag in rs.col_order
+        }
+        full.update(row_values)
+        state = self._record_write(state, class_name, row, record_name, rec_row, full)
+        cs = state.classes[class_name]
+        rec = cs.records[record_name]
+        rec = rec.replace(used=rec.used.at[row, rec_row].set(True))
+        return with_class(
+            state, class_name, cs.replace(records={**cs.records, record_name: rec})
+        )
+
     def record_remove_row(
         self, state: WorldState, guid: Guid, record_name: str, rec_row: int
     ) -> WorldState:

@@ -27,6 +27,7 @@ import numpy as np
 from ...core.datatypes import Bank, DataType, Guid
 from ...game.world import GameWorld, WorldConfig
 from ...kernel.kernel import ObjectEvent, TickOutputs
+from ...persist.codec import serialize_properties, serialize_records
 from ..defines import EventCode, MsgID, ServerType
 from ..transport import EV_DISCONNECTED
 from ..wire import (
@@ -93,6 +94,9 @@ class GameRole(ServerRole):
         scene_id: int = 1,
         sync_classes: Sequence[str] = ("Player", "NPC"),
         skill_damage: int = 10,
+        data_agent=None,
+        role_store=None,
+        autosave_seconds: float = 30.0,
     ) -> None:
         self.game_world = world if world is not None else GameWorld(
             WorldConfig(combat=False, movement=False, regen=True)
@@ -110,9 +114,13 @@ class GameRole(ServerRole):
         # sessions by client ident; reverse map guid -> ident key
         self.sessions: Dict[_IdentKey, Session] = {}
         self._guid_session: Dict[Guid, _IdentKey] = {}
-        # account -> role rows (in-memory until the persist agent binds in)
+        # account -> role rows; backed by role_store when one is attached
         self.roles: Dict[str, List[RoleLiteInfo]] = {}
+        self.role_store = role_store
+        self.data_agent = data_agent
         self._last_tick = 0.0
+        self.autosave_seconds = autosave_seconds
+        self._last_autosave = 0.0
         super().__init__(config, backend=backend)
         self.world_link = self.add_upstream(
             "world",
@@ -132,6 +140,10 @@ class GameRole(ServerRole):
                 per_level={"MAXHP": 20, "ATK_VALUE": 2, "DEF_VALUE": 1},
             )
             pc.freeze()
+        if self.data_agent is not None:
+            # bind BEFORE our own class-event hooks so load-on-create runs
+            # inside the COE chain ahead of the enter-scene snapshot
+            self.data_agent.bind(self.kernel)
         self.kernel.register_class_event(self._on_class_event, "Player")
         self.kernel.register_class_event(self._on_npc_event, "NPC")
         # subscribe every public property of the synced classes; the kernel
@@ -203,11 +215,24 @@ class GameRole(ServerRole):
         sess.conn_id = conn_id
         return sess
 
+    def _get_roles(self, account: str) -> List[RoleLiteInfo]:
+        roles = self.roles.get(account)
+        if roles is None:
+            roles = (self.role_store.load(account)
+                     if self.role_store is not None else [])
+            self.roles[account] = roles
+        return roles
+
+    def _put_roles(self, account: str, roles: List[RoleLiteInfo]) -> None:
+        self.roles[account] = roles
+        if self.role_store is not None:
+            self.role_store.save(account, roles)
+
     def _on_role_list(self, conn_id: int, _msg_id: int, body: bytes) -> None:
         base, req = unwrap(body, ReqRoleList)
         sess = self._session_for(conn_id, base)
         sess.account = req.account.decode("utf-8", "replace") or sess.account
-        ack = AckRoleLiteInfoList(char_data=self.roles.get(sess.account, []))
+        ack = AckRoleLiteInfoList(char_data=self._get_roles(sess.account))
         self._send_to_session(sess, MsgID.ACK_ROLE_LIST, ack)
 
     def _on_create_role(self, conn_id: int, _msg_id: int, body: bytes) -> None:
@@ -215,7 +240,7 @@ class GameRole(ServerRole):
         sess = self._session_for(conn_id, base)
         account = req.account.decode("utf-8", "replace") or sess.account
         sess.account = account
-        roles = self.roles.setdefault(account, [])
+        roles = self._get_roles(account)
         name = req.noob_name
         if any(r.noob_name == name for r in roles):
             code = int(EventCode.CHARACTER_EXIST)
@@ -231,6 +256,7 @@ class GameRole(ServerRole):
                     role_level=1,
                 )
             )
+            self._put_roles(account, roles)
             code = int(EventCode.SUCCESS)
         self._send_to_session(
             sess, MsgID.EVENT_RESULT, AckEventResult(event_code=code)
@@ -243,11 +269,15 @@ class GameRole(ServerRole):
         base, req = unwrap(body, ReqDeleteRole)
         sess = self._session_for(conn_id, base)
         account = req.account.decode("utf-8", "replace") or sess.account
-        roles = self.roles.get(account, [])
-        self.roles[account] = [r for r in roles if r.noob_name != req.name]
+        remaining = [r for r in self._get_roles(account)
+                     if r.noob_name != req.name]
+        self._put_roles(account, remaining)
+        if self.data_agent is not None:
+            name = req.name.decode("utf-8", "replace")
+            self.data_agent.delete(f"{account}:{name}")
         self._send_to_session(
             sess, MsgID.ACK_ROLE_LIST,
-            AckRoleLiteInfoList(char_data=self.roles[account]),
+            AckRoleLiteInfoList(char_data=remaining),
         )
 
     # ------------------------------------------------------------ enter/leave
@@ -266,14 +296,20 @@ class GameRole(ServerRole):
         )
         sess.guid = guid
         self._guid_session[guid] = _ident_key(sess.ident)
-        # level-1 stat init: JOBLEVEL row from config, recompute, refill
-        # (reference OnObjectLevelEvent → RefreshBaseProperty → full HP)
+        # stat init: fresh players get level 1 + full refill; returning
+        # players keep their loaded Level/HP (the data agent attached the
+        # saved blob during CREATE_LOADDATA) and only the derived stats
+        # are rebuilt (reference OnObjectLevelEvent → RefreshBaseProperty)
         gw = self.game_world
-        self.kernel.set_property(guid, "Level", 1)
+        loaded = (self.data_agent is not None and sess.account
+                  and self.data_agent.exists(f"{sess.account}:{name}"))
+        if not loaded:
+            self.kernel.set_property(guid, "Level", 1)
         gw.properties.refresh_base_property(guid, gw.property_config)
         gw.properties.recompute_now(guid)
-        gw.properties.full_hp_mp(guid)
-        gw.properties.full_sp(guid)
+        if not loaded:
+            gw.properties.full_hp_mp(guid)
+            gw.properties.full_sp(guid)
         # enter-scene pipeline (RequestEnterScene semantics)
         self.scene.enter_scene(guid, self.scene_id, 1)
         ack = AckEventResult(
@@ -329,71 +365,22 @@ class GameRole(ServerRole):
 
     def _property_list(self, guid: Guid, include_private: bool) -> ObjectPropertyList:
         """Full property snapshot (OnPropertyEnter: Public to others,
-        Public+Private to self)."""
-        k = self.kernel
-        cname, row = k.store.row_of(guid)
-        spec = k.store.spec(cname)
-        cs = k.state.classes[cname]
-        out = ObjectPropertyList(player_id=guid_ident(guid))
-        banks = {Bank.I32: np.asarray(cs.i32[row]),
-                 Bank.F32: np.asarray(cs.f32[row]),
-                 Bank.VEC: np.asarray(cs.vec[row])}
-        for bank, rowvals in banks.items():
-            for slot in spec.bank_props(bank):
-                p = slot.prop
-                if not (p.public or (include_private and p.private)):
-                    continue
-                raw = rowvals[slot.col]
-                if p.type == DataType.INT:
-                    out.property_int_list.append(
-                        PropertyInt(property_name=p.name.encode(), data=int(raw)))
-                elif p.type == DataType.FLOAT:
-                    out.property_float_list.append(
-                        PropertyFloat(property_name=p.name.encode(), data=float(raw)))
-                elif p.type == DataType.STRING:
-                    s = k.store.strings.lookup(int(raw))
-                    out.property_string_list.append(
-                        PropertyString(property_name=p.name.encode(), data=s.encode()))
-                elif p.type in (DataType.VECTOR2, DataType.VECTOR3):
-                    out.property_vector3_list.append(
-                        PropertyVector3(
-                            property_name=p.name.encode(),
-                            data=Vector3(x=float(raw[0]), y=float(raw[1]),
-                                         z=float(raw[2])),
-                        ))
+        Public+Private to self) via the shared serializer."""
+        pred = (lambda d: d.flag("public") or d.flag("private")) \
+            if include_private else (lambda d: d.flag("public"))
+        out = serialize_properties(self.kernel.store, self.kernel.state,
+                                   guid, pred)
+        out.player_id = guid_ident(guid)
         return out
 
     def _record_list(self, guid: Guid, include_private: bool) -> ObjectRecordList:
-        """Record snapshot for the flag-visible records (OnRecordEnter)."""
-        k = self.kernel
-        cname, row = k.store.row_of(guid)
-        spec = k.store.spec(cname)
-        out = ObjectRecordList(player_id=guid_ident(guid))
-        for rname, rs in spec.records.items():
-            rdef = rs.rec
-            if not (rdef.public or (include_private and rdef.private)):
-                continue
-            rstate = k.state.classes[cname].records[rname]
-            used = np.asarray(rstate.used[row])
-            if not used.any():
-                continue
-            r_i32 = np.asarray(rstate.i32[row]) if rs.n_i32 else None
-            r_f32 = np.asarray(rstate.f32[row]) if rs.n_f32 else None
-            base = ObjectRecordBase(record_name=rname.encode())
-            for r_i in np.flatnonzero(used):
-                row_struct = RecordAddRowStruct(row=int(r_i))
-                for c_i, tag in enumerate(rs.col_order):
-                    cslot = rs.cols[tag]
-                    if cslot.bank == Bank.I32 and r_i32 is not None:
-                        row_struct.record_int_list.append(RecordInt(
-                            row=int(r_i), col=c_i,
-                            data=int(r_i32[int(r_i), cslot.col])))
-                    elif cslot.bank == Bank.F32 and r_f32 is not None:
-                        row_struct.record_float_list.append(RecordFloat(
-                            row=int(r_i), col=c_i,
-                            data=float(r_f32[int(r_i), cslot.col])))
-                base.row_struct.append(row_struct)
-            out.record_list.append(base)
+        """Record snapshot for the flag-visible records (OnRecordEnter)
+        via the shared serializer."""
+        pred = (lambda d: d.flag("public") or d.flag("private")) \
+            if include_private else (lambda d: d.flag("public"))
+        out = serialize_records(self.kernel.store, self.kernel.state,
+                                guid, pred)
+        out.player_id = guid_ident(guid)
         return out
 
     def _send_snapshots(self, sess: Session) -> None:
@@ -501,6 +488,14 @@ class GameRole(ServerRole):
                 self._flush_changes()
             else:
                 self._changed.clear()
+        # periodic autosave: device-side deaths free the row before any
+        # BEFORE_DESTROY hook can run, so the blob must already be fresh
+        if (self.data_agent is not None
+                and now - self._last_autosave >= self.autosave_seconds):
+            self._last_autosave = now
+            for sess in self.sessions.values():
+                if sess.guid is not None and sess.guid in self.kernel.store.guid_map:
+                    self.data_agent.save(sess.guid)
 
     def _queue_change(self, cname: str, pname: str, rows: np.ndarray) -> None:
         """Property-event sink: accumulate changed rows per (class, prop);

@@ -320,12 +320,22 @@ class RecordString(Message):
     FIELDS = [(1, "row", "int32", 0), (2, "col", "int32", 0), (3, "data", "bytes", b"")]
 
 
+class RecordObject(Message):
+    FIELDS = [(1, "row", "int32", 0), (2, "col", "int32", 0), (3, "data", Ident, None)]
+
+
+class RecordVector3(Message):
+    FIELDS = [(1, "row", "int32", 0), (2, "col", "int32", 0), (3, "data", Vector3, None)]
+
+
 class RecordAddRowStruct(Message):
     FIELDS = [
         (1, "row", "int32", 0),
         (2, "record_int_list", R(RecordInt), None),
         (3, "record_float_list", R(RecordFloat), None),
         (4, "record_string_list", R(RecordString), None),
+        (5, "record_object_list", R(RecordObject), None),
+        (7, "record_vector3_list", R(RecordVector3), None),
     ]
 
 

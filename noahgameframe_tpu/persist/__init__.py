@@ -1,0 +1,8 @@
+"""Persistence: KV backends, RESP client, blob codec, agents, checkpoints."""
+
+from .agent import PlayerDataAgent, RoleListStore  # noqa: F401
+from .checkpoint import load_world, save_world  # noqa: F401
+from .codec import ObjectDataPack, apply_snapshot, snapshot_object  # noqa: F401
+from .kv import FileKV, KVStore, MemoryKV  # noqa: F401
+from .resp import MiniRedisServer, RespKV  # noqa: F401
+from .sql import SqlModule, emit_ddl  # noqa: F401

@@ -1,0 +1,117 @@
+"""Data agents: event-driven per-entity persistence + role lists.
+
+Reference: NFDataAgent_NosqlPlugin — player save/load rides the object
+lifecycle: on COE_CREATE_LOADDATA the saved protobuf blob is attached to
+the fresh object, on destroy/offline the live managers are converted
+back and written (`NFCPlayerRedisModule.cpp:226-321`); account role
+lists live under their own keys.  Here the same hooks bind to the
+kernel's class-event chain, and blobs are the codec.py packs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..core.datatypes import Guid
+from ..kernel.kernel import Kernel, ObjectEvent
+from ..net.wire import AckRoleLiteInfoList, RoleLiteInfo
+from .codec import apply_snapshot, snapshot_object
+from .kv import KVStore
+
+KeyFn = Callable[[Guid], Optional[str]]
+
+
+class PlayerDataAgent:
+    """Save-on-destroy / load-on-create for one class (default Player).
+
+    The storage key is derived per object by `key_fn`.  The default is
+    "account:name" — one slot per character, so two roles on one account
+    never share a blob (the reference likewise keys role blobs by role,
+    not account).  Only Save-flagged (optionally Cache) columns persist."""
+
+    def __init__(
+        self,
+        kv: KVStore,
+        class_name: str = "Player",
+        key_prefix: str = "obj:",
+        flags: tuple = ("save",),
+        key_fn: Optional[KeyFn] = None,
+    ) -> None:
+        self.kv = kv
+        self.class_name = class_name
+        self.key_prefix = key_prefix
+        self.flags = flags
+        self.kernel: Optional[Kernel] = None
+        self._key_fn = key_fn
+
+    def bind(self, kernel: Kernel) -> "PlayerDataAgent":
+        self.kernel = kernel
+        kernel.register_class_event(self._on_event, self.class_name)
+        return self
+
+    def _key_of(self, guid: Guid) -> Optional[str]:
+        if self._key_fn is not None:
+            k = self._key_fn(guid)
+            return None if not k else self.key_prefix + k
+        spec = self.kernel.store.spec(self.class_name)
+        if spec.has_property("Account") and spec.has_property("Name"):
+            account = str(self.kernel.get_property(guid, "Account"))
+            name = str(self.kernel.get_property(guid, "Name"))
+            if account and name:
+                return f"{self.key_prefix}{account}:{name}"
+        return None
+
+    # -- lifecycle hooks ------------------------------------------------
+    def _on_event(self, guid: Guid, cname: str, ev: ObjectEvent) -> None:
+        if ev == ObjectEvent.CREATE_LOADDATA:
+            self.load(guid)
+        elif ev == ObjectEvent.BEFORE_DESTROY:
+            self.save(guid)
+
+    def load(self, guid: Guid) -> bool:
+        key = self._key_of(guid)
+        if key is None:
+            return False
+        blob = self.kv.get(key)
+        if blob is None:
+            return False
+        k = self.kernel
+        k.state = apply_snapshot(k.store, k.state, guid, blob)
+        return True
+
+    def save(self, guid: Guid) -> bool:
+        key = self._key_of(guid)
+        if key is None:
+            return False
+        k = self.kernel
+        self.kv.set(key, snapshot_object(k.store, k.state, guid, self.flags))
+        return True
+
+    def exists(self, key: str) -> bool:
+        """key is the suffix after the prefix, e.g. "account:RoleName"."""
+        return self.kv.exists(self.key_prefix + key)
+
+    def delete(self, key: str) -> bool:
+        """Drop a character's blob (role deletion)."""
+        return self.kv.delete(self.key_prefix + key)
+
+
+class RoleListStore:
+    """Account → role-list persistence (the pre-enter-game role CRUD data;
+    reference NFCAccountRedisModule keeps these under account keys)."""
+
+    def __init__(self, kv: KVStore, key_prefix: str = "roles:") -> None:
+        self.kv = kv
+        self.key_prefix = key_prefix
+
+    def load(self, account: str) -> List[RoleLiteInfo]:
+        blob = self.kv.get(self.key_prefix + account)
+        if blob is None:
+            return []
+        return list(AckRoleLiteInfoList.decode(blob).char_data)
+
+    def save(self, account: str, roles: List[RoleLiteInfo]) -> None:
+        self.kv.set(
+            self.key_prefix + account,
+            AckRoleLiteInfoList(char_data=list(roles)).encode(),
+        )

@@ -1,0 +1,276 @@
+"""Schema-driven object ↔ wire serialization (persistence + sync share it).
+
+Reference: NFCCommonRedisModule converts a live object's property/record
+managers to `ObjectPropertyList`/`ObjectRecordList` protos and back
+(`NFCCommonRedisModule.h:45-49`); only properties/records flagged
+Save/Cache participate (flag plumbing `NFCKernelModule.cpp:158-184`).
+The network sync path serializes the *same* structures with a different
+flag predicate (Public/Private, `NFCGameServerNet_ServerModule.cpp:
+271-400`), so both paths here go through one serializer parameterized by
+a predicate — the save blob is literally a replayable sync burst.
+
+GUID-valued cells (OBJECT properties and record columns) are written as
+wire Idents, never as packed row handles: row handles are allocation-
+dependent and dangle across restarts.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from ..core.datatypes import Bank, DataType, Guid
+from ..core.store import EntityStore, WorldState
+from ..net.wire import (
+    Ident,
+    Message,
+    ObjectPropertyList,
+    ObjectRecordBase,
+    ObjectRecordList,
+    PropertyFloat,
+    PropertyInt,
+    PropertyObject,
+    PropertyString,
+    PropertyVector3,
+    RecordAddRowStruct,
+    RecordFloat,
+    RecordInt,
+    RecordObject,
+    RecordString,
+    RecordVector3,
+    Vector3,
+)
+
+# predicate over a PropertyDef / RecordDef deciding inclusion
+DefPredicate = Callable[[object], bool]
+
+
+def flag_predicate(flags: Tuple[str, ...]) -> DefPredicate:
+    return lambda d: any(d.flag(f) for f in flags)
+
+
+def _guid_to_ident(store: EntityStore, handle: int) -> Ident:
+    g = store.guid_of_handle(int(handle))
+    return Ident(svrid=g.head if g else 0, index=g.data if g else 0)
+
+
+def serialize_properties(
+    store: EntityStore,
+    state: WorldState,
+    guid: Guid,
+    pred: DefPredicate,
+) -> ObjectPropertyList:
+    """One entity's predicate-selected properties as a wire list, read
+    straight out of the SoA row slices."""
+    cname, row = store.row_of(guid)
+    spec = store.spec(cname)
+    cs = state.classes[cname]
+    out = ObjectPropertyList(player_id=Ident(svrid=guid.head, index=guid.data))
+    banks = {
+        Bank.I32: np.asarray(cs.i32[row]),
+        Bank.F32: np.asarray(cs.f32[row]),
+        Bank.VEC: np.asarray(cs.vec[row]),
+    }
+    for bank, rowvals in banks.items():
+        for slot in spec.bank_props(bank):
+            p = slot.prop
+            if not pred(p):
+                continue
+            raw = rowvals[slot.col]
+            name = p.name.encode()
+            if p.type == DataType.INT:
+                out.property_int_list.append(
+                    PropertyInt(property_name=name, data=int(raw)))
+            elif p.type == DataType.FLOAT:
+                out.property_float_list.append(
+                    PropertyFloat(property_name=name, data=float(raw)))
+            elif p.type == DataType.STRING:
+                out.property_string_list.append(PropertyString(
+                    property_name=name,
+                    data=store.strings.lookup(int(raw)).encode()))
+            elif p.type == DataType.OBJECT:
+                out.property_object_list.append(PropertyObject(
+                    property_name=name, data=_guid_to_ident(store, raw)))
+            else:  # VECTOR2 / VECTOR3 (vec bank)
+                out.property_vector3_list.append(PropertyVector3(
+                    property_name=name,
+                    data=Vector3(x=float(raw[0]), y=float(raw[1]),
+                                 z=float(raw[2]))))
+    return out
+
+
+def serialize_records(
+    store: EntityStore,
+    state: WorldState,
+    guid: Guid,
+    pred: DefPredicate,
+) -> ObjectRecordList:
+    """One entity's predicate-selected records, all column types."""
+    cname, row = store.row_of(guid)
+    spec = store.spec(cname)
+    cs = state.classes[cname]
+    out = ObjectRecordList(player_id=Ident(svrid=guid.head, index=guid.data))
+    for rname, rs in spec.records.items():
+        if not pred(rs.rec):
+            continue
+        rstate = cs.records[rname]
+        used = np.asarray(rstate.used[row])
+        if not used.any():
+            continue
+        r_i32 = np.asarray(rstate.i32[row]) if rs.n_i32 else None
+        r_f32 = np.asarray(rstate.f32[row]) if rs.n_f32 else None
+        r_vec = np.asarray(rstate.vec[row]) if rs.n_vec else None
+        base = ObjectRecordBase(record_name=rname.encode())
+        for r_i in np.flatnonzero(used):
+            rowmsg = RecordAddRowStruct(row=int(r_i))
+            for c_i, tag in enumerate(rs.col_order):
+                cslot = rs.cols[tag]
+                t = cslot.col_def.type
+                if cslot.bank == Bank.I32:
+                    raw = int(r_i32[int(r_i), cslot.col])
+                    if t == DataType.STRING:
+                        rowmsg.record_string_list.append(RecordString(
+                            row=int(r_i), col=c_i,
+                            data=store.strings.lookup(raw).encode()))
+                    elif t == DataType.OBJECT:
+                        rowmsg.record_object_list.append(RecordObject(
+                            row=int(r_i), col=c_i,
+                            data=_guid_to_ident(store, raw)))
+                    else:
+                        rowmsg.record_int_list.append(RecordInt(
+                            row=int(r_i), col=c_i, data=raw))
+                elif cslot.bank == Bank.F32:
+                    rowmsg.record_float_list.append(RecordFloat(
+                        row=int(r_i), col=c_i,
+                        data=float(r_f32[int(r_i), cslot.col])))
+                else:
+                    v = r_vec[int(r_i), cslot.col]
+                    rowmsg.record_vector3_list.append(RecordVector3(
+                        row=int(r_i), col=c_i,
+                        data=Vector3(x=float(v[0]), y=float(v[1]),
+                                     z=float(v[2]))))
+            base.row_struct.append(rowmsg)
+        out.record_list.append(base)
+    return out
+
+
+class ObjectDataPack(Message):
+    """The persisted unit: class name + flagged properties + records."""
+
+    FIELDS = [
+        (1, "class_name", "bytes", b""),
+        (2, "property_list", ObjectPropertyList, None),
+        (3, "record_list", ObjectRecordList, None),
+        (4, "guid", Ident, None),
+    ]
+
+
+def snapshot_object(
+    store: EntityStore,
+    state: WorldState,
+    guid: Guid,
+    flags: Tuple[str, ...] = ("save",),
+) -> bytes:
+    """Serialize the flag-masked slice of one entity (save-on-destroy)."""
+    cname, _ = store.row_of(guid)
+    pred = flag_predicate(flags)
+    return ObjectDataPack(
+        class_name=cname.encode(),
+        property_list=serialize_properties(store, state, guid, pred),
+        record_list=serialize_records(store, state, guid, pred),
+        guid=Ident(svrid=guid.head, index=guid.data),
+    ).encode()
+
+
+def _ident_to_guid(store: EntityStore, ident: Optional[Ident]) -> Optional[Guid]:
+    if ident is None:
+        return Guid()
+    g = Guid(ident.svrid, ident.index)
+    if g.is_null() or g in store.guid_map:
+        return g
+    return None  # referenced entity no longer exists
+
+
+def apply_snapshot(
+    store: EntityStore,
+    state: WorldState,
+    guid: Guid,
+    blob: bytes,
+) -> WorldState:
+    """Write a saved blob back onto a live entity (load-on-create,
+    the COE_CREATE_LOADDATA attach)."""
+    pack = ObjectDataPack.decode(blob)
+    cname, _ = store.row_of(guid)
+    spec = store.spec(cname)
+    pl = pack.property_list or ObjectPropertyList()
+    for p in pl.property_int_list:
+        name = p.property_name.decode()
+        if spec.has_property(name):
+            state = store.set_property(state, guid, name, int(p.data))
+    for p in pl.property_float_list:
+        name = p.property_name.decode()
+        if spec.has_property(name):
+            state = store.set_property(state, guid, name, float(p.data))
+    for p in pl.property_string_list:
+        name = p.property_name.decode()
+        if spec.has_property(name):
+            state = store.set_property(state, guid, name, p.data.decode())
+    for p in pl.property_object_list:
+        name = p.property_name.decode()
+        if spec.has_property(name):
+            target = _ident_to_guid(store, p.data)
+            if target is not None:
+                state = store.set_property(state, guid, name, target)
+    for p in pl.property_vector3_list:
+        name = p.property_name.decode()
+        if not spec.has_property(name):
+            continue
+        v = p.data or Vector3()
+        t = spec.slot(name).prop.type
+        val = (v.x, v.y) if t == DataType.VECTOR2 else (v.x, v.y, v.z)
+        state = store.set_property(state, guid, name, val)
+
+    rl = pack.record_list or ObjectRecordList()
+    for rec in rl.record_list:
+        rname = rec.record_name.decode()
+        if rname not in spec.records:
+            continue
+        rs = spec.records[rname]
+
+        def tag_of(col: int) -> Optional[str]:
+            return rs.col_order[col] if col < len(rs.col_order) else None
+
+        for rowmsg in rec.row_struct:
+            values: Dict[str, object] = {}
+            for c in rowmsg.record_int_list:
+                tag = tag_of(c.col)
+                if tag is not None:
+                    values[tag] = int(c.data)
+            for c in rowmsg.record_float_list:
+                tag = tag_of(c.col)
+                if tag is not None:
+                    values[tag] = float(c.data)
+            for c in rowmsg.record_string_list:
+                tag = tag_of(c.col)
+                if tag is not None:
+                    values[tag] = c.data.decode()
+            for c in rowmsg.record_object_list:
+                tag = tag_of(c.col)
+                if tag is not None:
+                    target = _ident_to_guid(store, c.data)
+                    if target is not None:
+                        values[tag] = target
+            for c in rowmsg.record_vector3_list:
+                tag = tag_of(c.col)
+                if tag is None:
+                    continue
+                v = c.data or Vector3()
+                t = rs.cols[tag].col_def.type
+                values[tag] = ((v.x, v.y) if t == DataType.VECTOR2
+                               else (v.x, v.y, v.z))
+            if rowmsg.row < rs.max_rows:
+                state = store.record_restore_row(
+                    state, guid, rname, int(rowmsg.row), values
+                )
+    return state

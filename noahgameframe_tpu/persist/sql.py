@@ -1,0 +1,152 @@
+"""Relational persistence: the reference MySQL module's API over SQLite.
+
+Reference: NFMysqlPlugin exposes a key-value-style API over tables —
+`Updata/Query/Select/Delete/Exists/Keys` with (table, key, fieldVec,
+valueVec) signatures (`NFCMysqlModule.h:32-40`) plus a driver manager
+with reconnect keepalive.  The engine here is stdlib sqlite3 (no server
+dependency); the API shape is preserved so a real MySQL driver can slot
+behind the same calls.  Rows are (id TEXT PRIMARY KEY, field columns
+added on demand) exactly like the reference's generated NFrame.sql
+tables.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+_ID = "id"
+
+
+def _q(name: str) -> str:
+    """Quote an identifier; reject anything that cannot be a column."""
+    if not name.replace("_", "").isalnum():
+        raise ValueError(f"bad identifier {name!r}")
+    return f'"{name}"'
+
+
+class SqlModule:
+    """Updata/Query/Select/Delete/Exists/Keys over a SQLite database."""
+
+    def __init__(self, path: Union[str, Path] = ":memory:") -> None:
+        self._conn = sqlite3.connect(str(path), check_same_thread=False)
+        self._lock = threading.Lock()
+        self._known_cols: Dict[str, set] = {}
+
+    # -- schema management (CREATE TABLE on demand) ---------------------
+    def _ensure(self, table: str, fields: Sequence[str]) -> None:
+        t = _q(table)
+        with self._lock:
+            cols = self._known_cols.get(table)
+            if cols is None:
+                self._conn.execute(
+                    f"CREATE TABLE IF NOT EXISTS {t} ({_ID} TEXT PRIMARY KEY)"
+                )
+                cols = {
+                    r[1]
+                    for r in self._conn.execute(f"PRAGMA table_info({t})")
+                }
+                self._known_cols[table] = cols
+            for f in fields:
+                if f not in cols:
+                    self._conn.execute(f"ALTER TABLE {t} ADD COLUMN {_q(f)}")
+                    cols.add(f)
+
+    # -- reference-shaped API -------------------------------------------
+    def updata(self, table: str, key: str, fields: Sequence[str],
+               values: Sequence[Union[str, bytes, int, float]]) -> bool:
+        """Upsert one row (the reference's spelling)."""
+        if len(fields) != len(values):
+            return False
+        self._ensure(table, fields)
+        with self._lock:
+            if not fields:  # key-only touch
+                self._conn.execute(
+                    f"INSERT OR IGNORE INTO {_q(table)} ({_ID}) VALUES (?)",
+                    [key],
+                )
+            else:
+                cols = ", ".join(_q(f) for f in fields)
+                marks = ", ".join("?" for _ in fields)
+                sets = ", ".join(f"{_q(f)}=excluded.{_q(f)}" for f in fields)
+                self._conn.execute(
+                    f"INSERT INTO {_q(table)} ({_ID}, {cols}) "
+                    f"VALUES (?, {marks}) ON CONFLICT({_ID}) DO UPDATE SET {sets}",
+                    [key, *values],
+                )
+            self._conn.commit()
+        return True
+
+    def query(self, table: str, key: str,
+              fields: Sequence[str]) -> Optional[List]:
+        """Read selected fields of one row (reference Query)."""
+        self._ensure(table, fields)
+        cols = ", ".join(_q(f) for f in fields)
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT {cols} FROM {_q(table)} WHERE {_ID}=?", [key]
+            ).fetchone()
+        return list(row) if row is not None else None
+
+    def select(self, table: str, key: str) -> Optional[Dict[str, object]]:
+        """Whole row as a field->value dict."""
+        self._ensure(table, ())
+        with self._lock:
+            cur = self._conn.execute(
+                f"SELECT * FROM {_q(table)} WHERE {_ID}=?", [key]
+            )
+            row = cur.fetchone()
+            if row is None:
+                return None
+            names = [d[0] for d in cur.description]
+        return dict(zip(names, row))
+
+    def delete(self, table: str, key: str) -> bool:
+        self._ensure(table, ())
+        with self._lock:
+            n = self._conn.execute(
+                f"DELETE FROM {_q(table)} WHERE {_ID}=?", [key]
+            ).rowcount
+            self._conn.commit()
+        return n > 0
+
+    def exists(self, table: str, key: str) -> bool:
+        self._ensure(table, ())
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT 1 FROM {_q(table)} WHERE {_ID}=?", [key]
+            ).fetchone()
+        return row is not None
+
+    def keys(self, table: str, like: str = "%") -> List[str]:
+        self._ensure(table, ())
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT {_ID} FROM {_q(table)} WHERE {_ID} LIKE ?", [like]
+            ).fetchall()
+        return sorted(r[0] for r in rows)
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+def emit_ddl(registry, class_names: Sequence[str]) -> str:
+    """Generate CREATE TABLE statements for save-flagged properties — the
+    NFrame.sql emitter of the reference codegen (`FileProcess.h:38-72`)."""
+    out: List[str] = []
+    for cname in class_names:
+        cdef = registry.get_def(cname)
+        cols = [f"  {_q(_ID)} TEXT PRIMARY KEY"]
+        for p in cdef.properties:
+            if not (p.save or p.cache):
+                continue
+            sql_t = {
+                1: "BIGINT", 2: "DOUBLE", 3: "TEXT",
+                4: "TEXT", 5: "TEXT", 6: "TEXT",
+            }[int(p.type)]
+            cols.append(f"  {_q(p.name)} {sql_t}")
+        body = ",\n".join(cols)
+        out.append(f"CREATE TABLE IF NOT EXISTS {_q(cname)} (\n{body}\n);")
+    return "\n".join(out)
