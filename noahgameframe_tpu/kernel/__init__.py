@@ -1,3 +1,4 @@
+from .actor import ActorModule, AsyncSqlModule, Component
 from .events import DeviceEvent, EventModule
 from .kernel import Kernel, ObjectEvent, TickCtx, TickOutputs
 from .module import Module, Phase
@@ -5,6 +6,9 @@ from .plugin import Plugin, PluginManager
 from .schedule import ScheduleModule
 
 __all__ = [
+    "ActorModule",
+    "AsyncSqlModule",
+    "Component",
     "DeviceEvent",
     "EventModule",
     "Kernel",

@@ -1,0 +1,4 @@
+"""Infra utilities: logging, metrics, profiler hooks."""
+
+from .log import LogLevel, LogModule  # noqa: F401
+from .metrics import TickMetrics, profiler_trace  # noqa: F401

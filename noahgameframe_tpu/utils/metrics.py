@@ -1,0 +1,103 @@
+"""Per-tick metrics + profiler hooks — the observability the reference
+lacks (SURVEY §5: easylogging's PERFORMANCE_TRACKING is disabled in every
+conf; the TPU build replaces it with real timing + JAX profiler traces).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import time
+from typing import Deque, Dict, Optional
+
+import numpy as np
+
+from ..kernel.module import Module
+
+
+class TickMetrics(Module):
+    """Rolling window of frame timings; p50/p95/p99, entities/sec, and a
+    JSON-line emitter for dashboards (the master /json analogue)."""
+
+    name = "TickMetrics"
+
+    def __init__(self, window: int = 512) -> None:
+        super().__init__()
+        self.window = window
+        self._durations: Deque[float] = collections.deque(maxlen=window)
+        self._t0: Optional[float] = None
+        self.frames = 0
+
+    # call around the tick (world/role loops use the context wrapper)
+    def frame_start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def frame_end(self) -> None:
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self.frames += 1
+        self._durations.append(dt)
+
+    @contextlib.contextmanager
+    def frame(self):
+        self.frame_start()
+        try:
+            yield
+        finally:
+            self.frame_end()
+
+    # -- aggregates ------------------------------------------------------
+    def percentiles(self) -> Dict[str, float]:
+        if not self._durations:
+            return {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0,
+                    "mean_ms": 0.0}
+        a = np.asarray(self._durations) * 1e3
+        return {
+            "p50_ms": float(np.percentile(a, 50)),
+            "p95_ms": float(np.percentile(a, 95)),
+            "p99_ms": float(np.percentile(a, 99)),
+            "mean_ms": float(a.mean()),
+        }
+
+    def live_entities(self) -> int:
+        if self.kernel is None:
+            return 0
+        return sum(
+            self.kernel.store.live_count(c)
+            for c in self.kernel.store.class_order
+        )
+
+    def entities_per_second(self) -> float:
+        if not self._durations:
+            return 0.0
+        mean_s = float(np.mean(self._durations))
+        return self.live_entities() / mean_s if mean_s > 0 else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        out = dict(self.percentiles())
+        out["frames"] = self.frames
+        live = self.live_entities()
+        mean_s = (float(np.mean(self._durations))
+                  if self._durations else 0.0)
+        out["entities_per_s"] = live / mean_s if mean_s > 0 else 0.0
+        out["live"] = live
+        return out
+
+    def json_line(self) -> str:
+        return json.dumps(self.snapshot())
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str):
+    """JAX profiler capture around a block — open the result with
+    TensorBoard/XProf to see the compiled tick's device timeline."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,133 @@
+"""Infra layer: actor offload + main-loop marshal, async SQL, log module,
+tick metrics (SURVEY §2.5, §2.6, §5)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from noahgameframe_tpu.kernel import ActorModule, AsyncSqlModule, Component
+from noahgameframe_tpu.persist import SqlModule
+from noahgameframe_tpu.utils import LogLevel, LogModule, TickMetrics
+
+
+# ---------------------------------------------------------------- actors
+
+
+def test_actor_offload_and_marshal_back():
+    am = ActorModule(threads=2)
+    comp = Component()
+    comp.on(1, lambda _m, x: x * 2)
+    aid = am.require_actor(comp)
+    results = []
+    main_thread = threading.get_ident()
+    worker_threads = set()
+
+    comp.on(2, lambda _m, x: worker_threads.add(threading.get_ident()) or x)
+
+    def end(actor_id, msg_id, result):
+        # end functors run on the DRAINING thread (the main loop)
+        assert threading.get_ident() == main_thread
+        results.append((actor_id, msg_id, result))
+
+    am.send_to_actor(aid, 1, 21, end)
+    am.send_to_actor(aid, 2, "t", end)
+    assert am.drain_until(2) == 2
+    assert (aid, 1, 42) in results
+    # the handler itself ran off the main thread
+    assert worker_threads and main_thread not in worker_threads
+    am.shut()
+
+
+def test_actor_message_ordering_per_mailbox():
+    am = ActorModule(threads=4)
+    seen = []
+    comp = Component()
+    comp.on_any(lambda _m, x: (time.sleep(0.001), seen.append(x))[1] or x)
+    aid = am.require_actor(comp)
+    for i in range(20):
+        am.send_to_actor(aid, 1, i, None)
+    am.drain_until(0, timeout=0.1)
+    deadline = time.monotonic() + 5
+    while len(seen) < 20 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert seen == list(range(20))  # one mailbox = strict order
+    am.shut()
+
+
+def test_actor_errors_are_collected_not_raised():
+    am = ActorModule(threads=1)
+    comp = Component()
+    comp.on(1, lambda _m, _x: 1 / 0)
+    aid = am.require_actor(comp)
+    am.send_to_actor(aid, 1, None, lambda *a: None)
+    am.drain_until(1, timeout=2.0)
+    errs = am.pop_errors()
+    assert len(errs) == 1 and isinstance(errs[0], ZeroDivisionError)
+    am.shut()
+
+
+def test_async_sql_module():
+    am = ActorModule(threads=2)
+    db = AsyncSqlModule(am, SqlModule())
+    got = []
+    db.updata("Player", "p1", ["Gold"], [7], cb=lambda ok: got.append(ok))
+    am.drain_until(1)
+    db.query("Player", "p1", ["Gold"], cb=lambda row: got.append(row))
+    am.drain_until(1)
+    assert got == [True, [7]]
+    am.shut()
+
+
+# ---------------------------------------------------------------- logging
+
+
+def test_log_module_game_api(tmp_path):
+    from noahgameframe_tpu.game.world import GameWorld, WorldConfig
+
+    w = GameWorld(WorldConfig(combat=False, movement=False,
+                              regen=False)).start()
+    w.scene.create_scene(1)
+    g = w.kernel.create_object("Player", {"Name": "LogMe", "Gold": 3},
+                               scene=1, group=0)
+    log = LogModule("GameServer", 6, log_dir=tmp_path)
+    log.kernel = w.kernel
+    log.info("server up on %s", "127.0.0.1")
+    log.log_property(LogLevel.WARNING, g, "HP", "clamped")
+    log.log_object(LogLevel.INFO, g)
+    log.shut()
+    text = (tmp_path / "GameServer_6.log").read_text()
+    assert "server up on 127.0.0.1" in text
+    assert "property=HP clamped" in text
+    assert "Name='LogMe'" in text and "Gold=3" in text
+    assert "[WARNING]" in text and "GameServer:6" in text
+
+
+def test_log_rollover(tmp_path):
+    log = LogModule("S", 1, log_dir=tmp_path, rollover_bytes=2048, backups=2)
+    for i in range(200):
+        log.info("x" * 64)
+    log.shut()
+    files = list(tmp_path.glob("S_1.log*"))
+    assert len(files) >= 2  # rolled at least once
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_tick_metrics_window_and_json():
+    m = TickMetrics(window=8)
+    for _ in range(20):
+        with m.frame():
+            time.sleep(0.001)
+    assert m.frames == 20
+    assert len(m._durations) == 8  # window bounded
+    p = m.percentiles()
+    assert p["p50_ms"] >= 1.0
+    assert p["p99_ms"] >= p["p50_ms"]
+    import json
+
+    snap = json.loads(m.json_line())
+    assert snap["frames"] == 20
