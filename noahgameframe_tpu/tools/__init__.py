@@ -1,0 +1,11 @@
+"""Codegen tooling: the NFFileProcess-equivalent config pipeline."""
+
+from .codegen import (  # noqa: F401
+    CodegenPipeline,
+    emit_instance_xml,
+    emit_logic_class_xml,
+    emit_name_constants,
+    load_class_csv,
+    load_class_xlsx,
+)
+from .xlsx import read_xlsx_sheets  # noqa: F401

@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Run the config codegen pipeline (GenerateConfigXML.sh equivalent).
+
+    python scripts/codegen.py --in config_src/ --out NFDataCfg/
+
+Reads CSV/XLSX class sheets (+ `<Class>.ini.csv` element rows) and emits
+reference-format Struct/Ini XML, a Python name-constant module, and SQL
+DDL.  See noahgameframe_tpu/tools/codegen.py for the sheet layout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from noahgameframe_tpu.tools import CodegenPipeline  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--in", dest="in_dir", required=True, type=Path)
+    ap.add_argument("--out", dest="out_dir", required=True, type=Path)
+    args = ap.parse_args()
+    report = CodegenPipeline(args.in_dir, args.out_dir).run()
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

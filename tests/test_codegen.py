@@ -1,0 +1,170 @@
+"""Codegen pipeline: CSV/XLSX sheets → Struct/Ini XML → loadable registry
+→ name constants → SQL DDL (SURVEY §2.10 NFFileProcess)."""
+
+from __future__ import annotations
+
+import sqlite3
+from pathlib import Path
+
+import pytest
+
+from noahgameframe_tpu.core.element import ElementStore
+from noahgameframe_tpu.core.schema import load_logic_class_xml
+from noahgameframe_tpu.tools import (
+    CodegenPipeline,
+    emit_name_constants,
+    load_class_csv,
+    load_class_xlsx,
+)
+from noahgameframe_tpu.tools.xlsx import read_xlsx_sheets, write_xlsx
+
+IOBJECT_CSV = """[class],name=IObject
+[property]
+Name,Type,Public,Private,Save,Cache,Ref,Upload,Desc
+ID,string,0,1,0,0,0,0,
+ClassName,string,0,1,0,0,0,0,
+SceneID,int,0,1,0,0,0,0,
+GroupID,int,0,1,0,0,0,0,
+"""
+
+HERO_CSV = """[class],name=Hero,parent=IObject,instancepath=Ini/Hero.xml
+[property]
+Name,Type,Public,Private,Save,Cache,Ref,Upload,Desc
+HP,int,1,1,1,0,0,0,hit points
+Speed,float,1,0,0,0,0,0,
+Title,string,1,1,1,1,0,0,
+Home,vector3,0,1,1,0,0,0,
+[record:Inventory],rows=8,public=1,save=1
+Tag,Type
+ItemID,string
+Count,int
+[components]
+Name,Language
+HeroAI,python
+"""
+
+HERO_INI_CSV = """Id,HP,Speed,Title
+hero_alpha,120,1.5,Captain
+hero_beta,90,2.25,Scout
+"""
+
+
+def write_inputs(d: Path) -> None:
+    (d / "IObject.csv").write_text(IOBJECT_CSV)
+    (d / "Hero.csv").write_text(HERO_CSV)
+    (d / "Hero.ini.csv").write_text(HERO_INI_CSV)
+
+
+def test_load_class_csv(tmp_path):
+    write_inputs(tmp_path)
+    cdef = load_class_csv(tmp_path / "Hero.csv")
+    assert cdef.name == "Hero" and cdef.parent == "IObject"
+    by_name = {p.name: p for p in cdef.properties}
+    assert by_name["HP"].save and by_name["HP"].public
+    assert by_name["Speed"].type.name == "FLOAT" and not by_name["Speed"].save
+    rec = cdef.records[0]
+    assert rec.name == "Inventory" and rec.max_rows == 8
+    assert [c.tag for c in rec.cols] == ["ItemID", "Count"]
+    assert rec.public and rec.save and not rec.private
+    assert cdef.components[0].name == "HeroAI"
+
+
+def test_pipeline_roundtrips_through_reference_loaders(tmp_path):
+    src, out = tmp_path / "src", tmp_path / "out"
+    src.mkdir()
+    write_inputs(src)
+    report = CodegenPipeline(src, out).run()
+    assert sorted(report["classes"]) == ["Hero", "IObject"]
+
+    # Struct XML loads through the same loader that reads reference data
+    reg = load_logic_class_xml(out / "Struct" / "LogicClass.xml",
+                               data_root=out)
+    assert "Hero" in reg
+    flat = reg._flatten("Hero")
+    names = [p.name for p in flat.properties]
+    assert names[:4] == ["ID", "ClassName", "SceneID", "GroupID"]  # inherited
+    assert "HP" in names and "Home" in names
+    spec = reg.spec("Hero")
+    assert spec.records["Inventory"].max_rows == 8
+
+    # Ini XML loads through ElementStore
+    es = ElementStore(reg)
+    n = es.load_all(out)
+    assert n == 2
+    assert es.element("hero_alpha").values["HP"] == 120
+    assert abs(es.element("hero_beta").values["Speed"] - 2.25) < 1e-6
+
+    # name constants module is importable and correct
+    ns: dict = {}
+    exec((out / "proto_define.py").read_text(), ns)
+    assert ns["Hero"].HP == "HP"
+    assert ns["Hero"].ThisName == "Hero"
+    assert ns["Hero"].R_Inventory.Col_Count == 1
+    assert ns["IObject"].SceneID == "SceneID"
+
+    # SQL DDL executes and contains save-flagged columns only
+    ddl = (out / "NFrame.sql").read_text()
+    assert '"HP" BIGINT' in ddl and '"Title" TEXT' in ddl
+    assert '"Speed"' not in ddl  # not save-flagged
+    sqlite3.connect(":memory:").executescript(ddl)
+
+
+def test_xlsx_roundtrip(tmp_path):
+    rows = [
+        ["[class]", "name=Mini", "parent="],
+        ["[property]"],
+        ["Name", "Type", "Public", "Private", "Save"],
+        ["Level", "int", 1, 1, 1],
+        ["Nick", "string", 1, 0, 0],
+    ]
+    wb = tmp_path / "classes.xlsx"
+    write_xlsx(wb, {"Mini": rows})
+    # raw reader sees the values back
+    sheets = read_xlsx_sheets(wb)
+    assert sheets["Mini"][3][0] == "Level"
+    # and the class loader builds the def
+    defs = load_class_xlsx(wb)
+    assert len(defs) == 1
+    cdef = defs[0]
+    assert cdef.name == "Mini"
+    assert cdef.properties[0].name == "Level" and cdef.properties[0].save
+    assert cdef.properties[1].type.name == "STRING"
+
+
+def test_generated_world_actually_runs(tmp_path):
+    """The full loop: sheets → XML → registry → live ticking world."""
+    src, out = tmp_path / "src", tmp_path / "out"
+    src.mkdir()
+    write_inputs(src)
+    CodegenPipeline(src, out).run()
+    reg = load_logic_class_xml(out / "Struct" / "LogicClass.xml",
+                               data_root=out)
+    from noahgameframe_tpu.core.store import StoreConfig
+    from noahgameframe_tpu.kernel import Kernel, Plugin, PluginManager
+
+    k = Kernel(reg, StoreConfig(default_capacity=16))
+    pm = PluginManager()
+    pm.register_plugin(Plugin("KernelPlugin", [k]))
+    k.elements.load_all(out)
+    pm.start()
+    g = k.create_from_element("Hero", "hero_alpha")
+    assert int(k.get_property(g, "HP")) == 120
+    pm.run(2)
+    assert k.tick_count == 2
+
+
+def test_orphan_class_fails_loudly(tmp_path):
+    src, out = tmp_path / "src", tmp_path / "out"
+    src.mkdir()
+    (src / "Orphan.csv").write_text(
+        "[class],name=Orphan,parent=Nowhere\n[property]\n"
+        "Name,Type\nHP,int\n")
+    with pytest.raises(ValueError, match="Orphan"):
+        CodegenPipeline(src, out).run()
+
+
+def test_blank_type_cell_defaults_to_int(tmp_path):
+    (tmp_path / "C.csv").write_text(
+        "[class],name=C\n[property]\nName,Type,Public\nFoo,,1\n")
+    cdef = load_class_csv(tmp_path / "C.csv")
+    assert cdef.properties[0].type.name == "INT"
