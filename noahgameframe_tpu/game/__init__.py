@@ -1,9 +1,22 @@
 """Gameplay layer: the reference's NFGameServerPlugin/NFGameLogicPlugin
 capabilities rebuilt as batched device phases + host control-plane APIs."""
 
+from .buff import BuffModule
 from .combat import ATTACK_TIMER, CombatModule, SkillModule
-from .defines import COMM_PROPERTY_RECORD, GameEvent, NpcType, PropertyGroup, STAT_NAMES
+from .defines import (
+    COMM_PROPERTY_RECORD,
+    GameEvent,
+    ItemSubType,
+    ItemType,
+    NpcType,
+    PropertyGroup,
+    STAT_NAMES,
+    TaskState,
+)
+from .hero import HeroModule
+from .items import EquipModule, ItemModule, PackModule
 from .level import LevelModule
+from .task import TaskDef, TaskModule
 from .movement import MovementModule
 from .property_config import PropertyConfigModule
 from .regen import REGEN_TIMER, RegenModule
@@ -13,6 +26,16 @@ from .world import GameWorld, WorldConfig, build_benchmark_world
 
 __all__ = [
     "ATTACK_TIMER",
+    "BuffModule",
+    "EquipModule",
+    "HeroModule",
+    "ItemModule",
+    "ItemSubType",
+    "ItemType",
+    "PackModule",
+    "TaskDef",
+    "TaskModule",
+    "TaskState",
     "COMM_PROPERTY_RECORD",
     "CombatModule",
     "GameEvent",

@@ -1,0 +1,165 @@
+"""Buffs: timed stat modifiers, expired and folded entirely on device.
+
+Reference: NFCBuffModule (`NFServer/NFGameLogicPlugin/NFCBuffModule.cpp`)
+applies a buff's property deltas per object and reverts them on a timer
+callback — O(buffs) host work with per-buff heartbeats.
+
+TPU inversion: active buffs are rows in the `BuffList` record
+(ConfigIdx → a frozen [n_buffs, n_stats] config table, ExpireTick).  One
+phase per tick computes, for EVERY entity at once:
+
+    active[C, R]  = used & (expire > tick)
+    contrib[C, S] = sum_R  buff_table[cfg[C, R]] * active
+    RUNTIME_BUFF row of CommPropertyValue <- contrib
+
+and clears expired rows' used flags.  The stat recompute phase (order 60)
+then folds the group row into final stats, so the whole buff system —
+expiry, stacking, reverts — is two fused gathers with zero host work.
+This phase runs at order 55, just before the recompute.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.datatypes import Guid
+from ..core.store import WorldState, with_class
+from ..kernel.module import Module
+from .defines import COMM_PROPERTY_RECORD, PropertyGroup, STAT_NAMES
+
+BUFF_RECORD = "BuffList"
+
+
+class BuffModule(Module):
+    name = "BuffModule"
+
+    def __init__(self, classes: Sequence[str] = ("Player", "NPC"),
+                 order: int = 55) -> None:
+        super().__init__()
+        self.classes = tuple(classes)
+        self._defs: Dict[str, int] = {}  # buff id -> config index
+        self._durations: List[float] = []
+        self._stats: List[List[int]] = []
+        self._table: Optional[jnp.ndarray] = None
+        self._rec_cols: Dict[str, np.ndarray] = {}
+        self.add_phase("buffs", self._buff_phase, order=order)
+
+    # ------------------------------------------------------- definitions
+    def define_buff(self, buff_id: str, duration_s: float,
+                    stats: Dict[str, int]) -> int:
+        """Register (or redefine) a buff kind; returns its config index.
+        The table is a traced constant, so any change forces a retrace."""
+        idx = self._defs.get(buff_id)
+        if idx is None:
+            idx = len(self._durations)
+            self._defs[buff_id] = idx
+            self._durations.append(0.0)
+            self._stats.append([0] * len(STAT_NAMES))
+        self._durations[idx] = float(duration_s)
+        self._stats[idx] = [0] * len(STAT_NAMES)
+        for stat, v in stats.items():
+            self._stats[idx][STAT_NAMES.index(stat)] = int(v)
+        self._table = None
+        if self.kernel is not None:
+            self.kernel.invalidate()
+        return idx
+
+    def _frozen_table(self) -> jnp.ndarray:
+        if self._table is None:
+            rows = self._stats or [[0] * len(STAT_NAMES)]
+            self._table = jnp.asarray(np.asarray(rows, np.int32))
+        return self._table
+
+    def after_init(self) -> None:
+        store = self.kernel.store
+        for cname in self.classes:
+            if cname not in store.class_index:
+                continue
+            spec = store.spec(cname)
+            if BUFF_RECORD not in spec.records:
+                continue
+            if COMM_PROPERTY_RECORD not in spec.records:
+                continue
+            rs = spec.records[COMM_PROPERTY_RECORD]
+            self._rec_cols[cname] = np.asarray(
+                [rs.cols[n].col for n in STAT_NAMES], np.int32
+            )
+
+    # ------------------------------------------------------- host API
+    def apply_buff(self, guid: Guid, buff_id: str) -> bool:
+        """Add (or refresh) a timed buff on one entity."""
+        idx = self._defs.get(buff_id)
+        if idx is None:
+            return False
+        k = self.kernel
+        cname, _ = k.store.row_of(guid)
+        if BUFF_RECORD not in k.store.spec(cname).records:
+            return False
+        expire = int(k.state.tick) + max(
+            1, int(round(self._durations[idx] / k.schedule.dt))
+        )
+        rows = k.store.record_find_rows(k.state, guid, BUFF_RECORD,
+                                        "ConfigIdx", idx)
+        if rows:  # re-apply refreshes the expiry
+            k.state = k.store.record_set(k.state, guid, BUFF_RECORD,
+                                         rows[0], "ExpireTick", expire)
+            return True
+        try:
+            k.state, _ = k.store.record_add_row(
+                k.state, guid, BUFF_RECORD,
+                {"ConfigIdx": idx, "ExpireTick": expire},
+            )
+        except RuntimeError:
+            return False
+        return True
+
+    def active_buffs(self, guid: Guid) -> List[str]:
+        k = self.kernel
+        by_idx = {v: b for b, v in self._defs.items()}
+        out = []
+        cname, row = k.store.row_of(guid)
+        if BUFF_RECORD not in k.store.spec(cname).records:
+            return out
+        rec = k.state.classes[cname].records[BUFF_RECORD]
+        rs = k.store.spec(cname).records[BUFF_RECORD]
+        used = np.asarray(rec.used[row])
+        cfg = np.asarray(rec.i32[row, :, rs.cols["ConfigIdx"].col])
+        exp = np.asarray(rec.i32[row, :, rs.cols["ExpireTick"].col])
+        tick = int(k.state.tick)
+        for r in np.flatnonzero(used & (exp > tick)):
+            name = by_idx.get(int(cfg[r]))
+            if name:
+                out.append(name)
+        return out
+
+    # ------------------------------------------------------- device phase
+    def _buff_phase(self, state: WorldState, ctx) -> WorldState:
+        table = self._frozen_table()
+        for cname, rec_cols in self._rec_cols.items():
+            cs = state.classes[cname]
+            buf = cs.records[BUFF_RECORD]
+            rs = ctx.store.spec(cname).records[BUFF_RECORD]
+            cfg = buf.i32[:, :, rs.cols["ConfigIdx"].col]  # [C, R]
+            exp = buf.i32[:, :, rs.cols["ExpireTick"].col]
+            active = buf.used & (exp > ctx.tick)
+            # gather each row's stat vector, mask, sum over the buff axis
+            contrib = jnp.sum(
+                table[jnp.clip(cfg, 0, table.shape[0] - 1)]
+                * active[:, :, None].astype(jnp.int32),
+                axis=1,
+                dtype=jnp.int32,
+            )  # [C, S]
+            stats_rec = cs.records[COMM_PROPERTY_RECORD]
+            i32 = stats_rec.i32.at[
+                :, int(PropertyGroup.RUNTIME_BUFF), jnp.asarray(rec_cols)
+            ].set(contrib)
+            records = {
+                **cs.records,
+                COMM_PROPERTY_RECORD: stats_rec.replace(i32=i32),
+                BUFF_RECORD: buf.replace(used=active),  # expiry frees rows
+            }
+            state = with_class(state, cname, cs.replace(records=records))
+        return state

@@ -80,3 +80,37 @@ class GameEvent(enum.IntEnum):
     ON_LEVEL_UP = 2
     ON_NPC_RESPAWN = 3
     ON_USE_SKILL_RESULT = 4
+
+
+class ItemType(enum.IntEnum):
+    """Top-level item families (reference EItemType,
+    NFDefine.proto:341-348)."""
+
+    EQUIP = 0
+    GEM = 1
+    ITEM = 2
+    CARD = 3
+    TOKEN = 4
+
+
+class ItemSubType(enum.IntEnum):
+    """Consumable sub-kinds (reference EGameItemSubType,
+    NFDefine.proto:378-385)."""
+
+    WATER = 0
+    DIAMOND = 1
+    CURRENCY = 2
+    EXP = 3
+    HP = 4
+    MP = 5
+    SP = 6
+    PACK = 7
+
+
+class TaskState(enum.IntEnum):
+    """Task lifecycle (reference ETaskState, NFDefine.proto:432-438)."""
+
+    IN_PROCESS = 0
+    DONE = 1
+    DRAW_AWARD = 2
+    FINISH = 3

@@ -1,0 +1,101 @@
+"""Hero system: collection, leveling, fight-hero stat contribution.
+
+Reference: NFCHeroModule (`NFServer/NFGameLogicPlugin/NFCHeroModule.cpp`,
+443 LoC) — AddHero dedupes by ConfigID into the PlayerHero record,
+AddHeroExp levels the hero against the player's level cap, and switching
+the fight hero re-applies its config+level stats to the owner (via
+NFCHeroPropertyModule).  Here the fight hero's stats land in the
+EQUIP_AWARD group row so the per-tick recompute folds them in.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..core.datatypes import Guid
+from ..kernel.module import Module
+from .defines import STAT_NAMES, PropertyGroup
+
+HERO_RECORD = "PlayerHero"
+
+
+class HeroModule(Module):
+    name = "HeroModule"
+
+    def __init__(self, properties, exp_per_level: int = 100) -> None:
+        super().__init__()
+        self.properties = properties  # game.stats.PropertyModule
+        self.exp_per_level = exp_per_level
+        self._fight_hero: Dict[Guid, int] = {}  # owner -> hero record row
+
+    # ------------------------------------------------------- collection
+    def add_hero(self, guid: Guid, config_id: str) -> Optional[int]:
+        """Dedupe by ConfigID; returns the hero's record row."""
+        k = self.kernel
+        rows = k.store.record_find_rows(k.state, guid, HERO_RECORD,
+                                        "ConfigID", config_id)
+        if rows:
+            return rows[0]
+        try:
+            k.state, row = k.store.record_add_row(
+                k.state, guid, HERO_RECORD,
+                {"ConfigID": config_id, "Level": 1, "Exp": 0, "Star": 1},
+            )
+        except RuntimeError:
+            return None
+        return row
+
+    def hero_level(self, guid: Guid, row: int) -> int:
+        return int(self.kernel.store.record_get(
+            self.kernel.state, guid, HERO_RECORD, row, "Level"))
+
+    def add_hero_exp(self, guid: Guid, row: int, exp: int) -> int:
+        """Level against the owner's level cap (the reference caps hero
+        level at player level); returns the hero's new level."""
+        k = self.kernel
+        cap = int(k.get_property(guid, "Level")) or 1
+        level = self.hero_level(guid, row)
+        total = int(k.store.record_get(k.state, guid, HERO_RECORD, row,
+                                       "Exp")) + exp
+        while level < cap and total >= self.exp_per_level:
+            total -= self.exp_per_level
+            level += 1
+        k.state = k.store.record_set(k.state, guid, HERO_RECORD, row,
+                                     "Exp", total)
+        k.state = k.store.record_set(k.state, guid, HERO_RECORD, row,
+                                     "Level", level)
+        if self._fight_hero.get(guid) == row:
+            self._refresh_fight_stats(guid)
+        return level
+
+    # ------------------------------------------------------- fight hero
+    def set_fight_hero(self, guid: Guid, row: int) -> bool:
+        k = self.kernel
+        used = k.store.record_get(k.state, guid, HERO_RECORD, row, "ConfigID")
+        if not used:
+            return False
+        self._fight_hero[guid] = row
+        self._refresh_fight_stats(guid)
+        return True
+
+    def fight_hero(self, guid: Guid) -> Optional[int]:
+        return self._fight_hero.get(guid)
+
+    def _refresh_fight_stats(self, guid: Guid) -> None:
+        """Config stats × level into the EQUIP_AWARD group
+        (NFCHeroPropertyModule recompute shape)."""
+        k = self.kernel
+        row = self._fight_hero.get(guid)
+        if row is None:
+            return
+        config_id = str(k.store.record_get(k.state, guid, HERO_RECORD, row,
+                                           "ConfigID"))
+        level = self.hero_level(guid, row)
+        elems = k.elements
+        vals = (elems.element(config_id).values
+                if elems.exists(config_id) else {})
+        for n in STAT_NAMES:
+            base = int(vals.get(n, 0) or 0)
+            self.properties.set_group_value(
+                guid, n, PropertyGroup.EQUIP_AWARD, base * level
+            )

@@ -38,6 +38,17 @@ def _comm_property_record():
     )
 
 
+def _buff_record():
+    """Active timed buffs: config-table index + absolute expiry tick; a
+    device phase folds unexpired rows into the RUNTIME_BUFF stat group
+    (the reference NFCBuffModule applies/reverts per-buff callbacks)."""
+    return record(
+        "BuffList", 8,
+        [("ConfigIdx", "int"), ("ExpireTick", "int")],
+        private=True,
+    )
+
+
 def standard_registry(extra: Optional[Iterable[ClassDef]] = None) -> ClassRegistry:
     reg = ClassRegistry()
     reg.define(
@@ -88,6 +99,7 @@ def standard_registry(extra: Optional[Iterable[ClassDef]] = None) -> ClassRegist
             + _stat_props(),
             records=[
                 _comm_property_record(),
+                _buff_record(),
                 record(
                     "PlayerHero",
                     16,
@@ -163,7 +175,33 @@ def standard_registry(extra: Optional[Iterable[ClassDef]] = None) -> ClassRegist
                 prop("DeadTick", "int"),
             ]
             + _stat_props(),
-            records=[_comm_property_record()],
+            records=[_comm_property_record(), _buff_record()],
+        )
+    )
+    # item/equip config class (reference Item.xlsx → Class/Item.xml):
+    # consumables carry ItemType/SubType/AwardValue, equips carry the
+    # stat columns EquipModule folds into the NPG_EQUIP group
+    reg.define(
+        ClassDef(
+            name="Item",
+            parent="IObject",
+            properties=[
+                prop("ItemType", "int"),
+                prop("ItemSubType", "int"),
+                prop("Level", "int"),
+                prop("AwardValue", "int"),
+                prop("AwardProperty", "string"),
+                prop("CoolDownTime", "float"),
+                prop("OverlayCount", "int"),
+                prop("ExpiredType", "int"),
+                prop("BuyPrice", "int"),
+                prop("SalePrice", "int"),
+                prop("Script", "string"),
+                prop("Extend", "string"),
+                prop("Icon", "string"),
+                prop("HeroTye", "int"),
+            ]
+            + _stat_props(),
         )
     )
     # per-(job,level) base-stat table rows (reference InitProperty class,

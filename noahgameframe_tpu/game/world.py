@@ -20,7 +20,11 @@ from ..core.store import StoreConfig
 from ..kernel.kernel import Kernel
 from ..kernel.plugin import Plugin, PluginManager
 from ..kernel.scene import SceneModule
+from .buff import BuffModule
 from .combat import CombatModule, SkillModule
+from .hero import HeroModule
+from .items import EquipModule, ItemModule, PackModule
+from .task import TaskModule
 from .defines import COMM_PROPERTY_RECORD, PropertyGroup, STAT_NAMES
 from .level import LevelModule
 from .movement import MovementModule
@@ -45,6 +49,7 @@ class WorldConfig:
     combat: bool = True
     movement: bool = True
     regen: bool = True
+    middleware: bool = True  # items/hero/task/buff stack
     diff_flags: tuple = ("public", "upload")
 
 
@@ -76,6 +81,17 @@ class GameWorld:
         self.level = LevelModule(self.property_config, self.properties)
         self.skills = SkillModule()
         modules = [self.kernel, self.scene, self.property_config, self.properties, self.level, self.skills]
+        self.pack = self.items = self.equip = self.heroes = self.tasks = None
+        self.buffs = None
+        if cfg.middleware:
+            self.pack = PackModule()
+            self.items = ItemModule(self.pack)
+            self.equip = EquipModule(self.pack, self.properties)
+            self.heroes = HeroModule(self.properties)
+            self.tasks = TaskModule(self.level)
+            self.buffs = BuffModule()
+            modules += [self.pack, self.items, self.equip, self.heroes,
+                        self.tasks, self.buffs]
         self.movement = None
         self.combat = None
         self.regen = None
@@ -190,6 +206,7 @@ def build_benchmark_world(
             combat=combat,
             seed=seed,
             attack_period_s=attack_period_s,
+            middleware=False,
         )
     )
     w.start()

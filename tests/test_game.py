@@ -37,11 +37,13 @@ def test_stat_group_sum_becomes_property(small_world):
     g = w.kernel.create_object("Player", {"Job": 0, "Level": 1}, scene=1)
     w.properties.set_group_value(g, "ATK_VALUE", PropertyGroup.JOBLEVEL, 12)
     w.properties.set_group_value(g, "ATK_VALUE", PropertyGroup.EQUIP, 5)
-    w.properties.set_group_value(g, "ATK_VALUE", PropertyGroup.RUNTIME_BUFF, 3)
+    # RUNTIME_BUFF is device-owned by BuffModule (recomputed every tick);
+    # manual contributions belong in the other groups
+    w.properties.set_group_value(g, "ATK_VALUE", PropertyGroup.STATIC_BUFF, 3)
     w.tick()
     assert w.kernel.get_property(g, "ATK_VALUE") == 20
     # removing the buff contribution drops the final stat
-    w.properties.set_group_value(g, "ATK_VALUE", PropertyGroup.RUNTIME_BUFF, 0)
+    w.properties.set_group_value(g, "ATK_VALUE", PropertyGroup.STATIC_BUFF, 0)
     w.tick()
     assert w.kernel.get_property(g, "ATK_VALUE") == 17
 

@@ -1,0 +1,188 @@
+"""Gameplay middleware: pack/item/equip, hero, task, device-expired buffs
+(SURVEY §2.8 NFGameLogicPlugin, §2.9)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from noahgameframe_tpu.game import (
+    GameWorld,
+    ItemSubType,
+    ItemType,
+    PropertyGroup,
+    TaskDef,
+    TaskState,
+    WorldConfig,
+)
+
+
+@pytest.fixture()
+def world():
+    w = GameWorld(WorldConfig(combat=True, movement=False, regen=False,
+                              npc_capacity=64, player_capacity=8,
+                              attack_period_s=1 / 30, aoe_radius=1e6,
+                              respawn_s=1e6)).start()
+    w.scene.create_scene(1)
+    return w
+
+
+@pytest.fixture()
+def player(world):
+    g = world.kernel.create_object("Player", {"Name": "P", "Account": "p"},
+                                   scene=1, group=0)
+    world.kernel.set_property(g, "Level", 3)
+    return g
+
+
+def define_potion(world, item_id="potion_hp", sub=ItemSubType.HP, value=30):
+    world.kernel.elements.add_element("Item", item_id, {
+        "ItemType": int(ItemType.ITEM), "ItemSubType": int(sub),
+        "AwardValue": value})
+    return item_id
+
+
+# ---------------------------------------------------------------- pack/item
+
+
+def test_pack_stack_and_consume(world, player):
+    p = world.pack
+    assert p.create_item(player, "potion_hp", 3)
+    assert p.create_item(player, "potion_hp", 2)  # stacks
+    assert p.item_count(player, "potion_hp") == 5
+    assert p.enough_item(player, "potion_hp", 5)
+    assert p.delete_item(player, "potion_hp", 4)
+    assert p.item_count(player, "potion_hp") == 1
+    assert p.delete_item(player, "potion_hp", 1)
+    assert p.item_count(player, "potion_hp") == 0
+    assert not p.delete_item(player, "potion_hp", 1)
+
+
+def test_use_potion_restores_hp(world, player):
+    k = world.kernel
+    define_potion(world)
+    world.properties.set_group_value(player, "MAXHP", PropertyGroup.JOBLEVEL, 100)
+    world.properties.recompute_now(player)
+    k.set_property(player, "HP", 50)
+    world.pack.create_item(player, "potion_hp", 2)
+    assert world.items.use_item(player, "potion_hp")
+    assert int(k.get_property(player, "HP")) == 80
+    assert world.items.use_item(player, "potion_hp")
+    assert int(k.get_property(player, "HP")) == 100  # capped at MAXHP
+    assert not world.items.use_item(player, "potion_hp")  # bag empty
+
+
+def test_token_grants_gold(world, player):
+    world.kernel.elements.add_element("Item", "gold_pouch", {
+        "ItemType": int(ItemType.TOKEN),
+        "ItemSubType": int(ItemSubType.CURRENCY), "AwardValue": 250})
+    world.pack.create_item(player, "gold_pouch", 1)
+    g0 = int(world.kernel.get_property(player, "Gold"))
+    assert world.items.use_item(player, "gold_pouch")
+    assert int(world.kernel.get_property(player, "Gold")) == g0 + 250
+
+
+def test_equip_wear_feeds_stat_group(world, player):
+    world.kernel.elements.add_element("Item", "sword_1", {
+        "ItemType": int(ItemType.EQUIP), "ATK_VALUE": 15, "MAXHP": 40})
+    eq = world.pack.create_equip(player, "sword_1")
+    assert eq is not None
+    assert world.equip.wear(player, eq)
+    assert world.properties.get_group_value(
+        player, "ATK_VALUE", PropertyGroup.EQUIP) == 15
+    world.properties.recompute_now(player)
+    assert int(world.kernel.get_property(player, "ATK_VALUE")) == 15
+    assert world.equip.take_off(player, eq)
+    assert world.properties.get_group_value(
+        player, "ATK_VALUE", PropertyGroup.EQUIP) == 0
+
+
+# ---------------------------------------------------------------- hero
+
+
+def test_hero_collect_level_fight_stats(world, player):
+    world.kernel.elements.add_element("Item", "hero_knight", {
+        "ATK_VALUE": 5, "MAXHP": 20})
+    h = world.heroes
+    row = h.add_hero(player, "hero_knight")
+    assert row is not None
+    assert h.add_hero(player, "hero_knight") == row  # dedupe
+    assert h.set_fight_hero(player, row)
+    assert world.properties.get_group_value(
+        player, "ATK_VALUE", PropertyGroup.EQUIP_AWARD) == 5  # level 1
+    # hero exp levels up to the player's cap (player level 3)
+    lvl = h.add_hero_exp(player, row, 1000)
+    assert lvl == 3
+    assert world.properties.get_group_value(
+        player, "ATK_VALUE", PropertyGroup.EQUIP_AWARD) == 15
+
+
+# ---------------------------------------------------------------- task
+
+
+def test_task_accept_progress_award(world, player):
+    t = world.tasks
+    t.define_task(TaskDef("t_kill3", target_config="", count=3,
+                          award_gold=100, award_exp=0))
+    assert t.accept(player, "t_kill3")
+    assert not t.accept(player, "t_kill3")  # no duplicates
+    assert t.status(player, "t_kill3") == TaskState.IN_PROCESS
+    t.add_process(player, "t_kill3", 2)
+    assert t.process(player, "t_kill3") == 2
+    assert not t.draw_award(player, "t_kill3")  # not done yet
+    t.add_process(player, "t_kill3", 5)  # clamped at count
+    assert t.status(player, "t_kill3") == TaskState.DONE
+    g0 = int(world.kernel.get_property(player, "Gold"))
+    assert t.draw_award(player, "t_kill3")
+    assert int(world.kernel.get_property(player, "Gold")) == g0 + 100
+    assert t.status(player, "t_kill3") == TaskState.FINISH
+    assert not t.draw_award(player, "t_kill3")  # no double draw
+
+
+def test_task_counts_device_kills(world, player):
+    """Kill events from the jitted combat phase advance tasks batched."""
+    k = world.kernel
+    t = world.tasks
+    t.define_task(TaskDef("t_hunt", count=2, award_gold=10))
+    t.accept(player, "t_hunt")
+    # plant two NPCs about to die, attacker = the player
+    world.seed_npcs(2, scene=1, group=0, hp=1)
+    handle = k.store.handle_of(player)
+    npcs = world.scene.objects_in_group(1, 0, "NPC")
+    for npc in npcs:
+        k.state = k.store.set_property(k.state, npc, "HP", 0)
+        k.state = k.store.set_property(k.state, npc, "LastAttacker", handle)
+    k.tick()  # death phase emits ON_OBJECT_BE_KILLED with killer column
+    assert t.process(player, "t_hunt") == 2
+    assert t.status(player, "t_hunt") == TaskState.DONE
+
+
+# ---------------------------------------------------------------- buffs
+
+
+def test_buff_applies_and_expires_on_device(world, player):
+    b = world.buffs
+    b.define_buff("haste", duration_s=3 / 30, stats={"ATK_VALUE": 7,
+                                                     "MOVE_SPEED": 100})
+    assert b.apply_buff(player, "haste")
+    world.tick()
+    assert b.active_buffs(player) == ["haste"]
+    assert int(world.kernel.get_property(player, "ATK_VALUE")) == 7
+    # re-apply refreshes rather than stacking a second row
+    assert b.apply_buff(player, "haste")
+    world.run(2)
+    assert int(world.kernel.get_property(player, "ATK_VALUE")) == 7
+    world.run(4)  # past expiry
+    assert b.active_buffs(player) == []
+    assert int(world.kernel.get_property(player, "ATK_VALUE")) == 0
+
+
+def test_buffs_stack_distinct_kinds(world, player):
+    b = world.buffs
+    b.define_buff("b1", duration_s=10.0, stats={"DEF_VALUE": 3})
+    b.define_buff("b2", duration_s=10.0, stats={"DEF_VALUE": 4})
+    b.apply_buff(player, "b1")
+    b.apply_buff(player, "b2")
+    world.tick()
+    assert sorted(b.active_buffs(player)) == ["b1", "b2"]
+    assert int(world.kernel.get_property(player, "DEF_VALUE")) == 7
