@@ -21,6 +21,16 @@ from .movement import MovementModule
 from .property_config import PropertyConfigModule
 from .regen import REGEN_TIMER, RegenModule
 from .schema import standard_registry
+from .social import (
+    FriendModule,
+    GmModule,
+    GuildModule,
+    MailModule,
+    PvpMatchModule,
+    RankModule,
+    ShopModule,
+    TeamModule,
+)
 from .stats import PropertyModule
 from .world import GameWorld, WorldConfig, build_benchmark_world
 
@@ -36,6 +46,14 @@ __all__ = [
     "TaskDef",
     "TaskModule",
     "TaskState",
+    "FriendModule",
+    "GmModule",
+    "GuildModule",
+    "MailModule",
+    "PvpMatchModule",
+    "RankModule",
+    "ShopModule",
+    "TeamModule",
     "COMM_PROPERTY_RECORD",
     "CombatModule",
     "GameEvent",

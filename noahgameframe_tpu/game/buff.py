@@ -62,18 +62,20 @@ class BuffModule(Module):
         self._stats[idx] = [0] * len(STAT_NAMES)
         for stat, v in stats.items():
             self._stats[idx][STAT_NAMES.index(stat)] = int(v)
-        self._table = None
+        self._rebuild_table()
         if self.kernel is not None:
             self.kernel.invalidate()
         return idx
 
-    def _frozen_table(self) -> jnp.ndarray:
-        if self._table is None:
-            rows = self._stats or [[0] * len(STAT_NAMES)]
-            self._table = jnp.asarray(np.asarray(rows, np.int32))
-        return self._table
+    def _rebuild_table(self) -> None:
+        """Freeze the config table EAGERLY on the host.  Building it
+        lazily inside the traced phase would cache a tracer (shard_map
+        rejects the leak; plain jit silently re-creates it every trace)."""
+        rows = self._stats or [[0] * len(STAT_NAMES)]
+        self._table = jnp.asarray(np.asarray(rows, np.int32))
 
     def after_init(self) -> None:
+        self._rebuild_table()
         store = self.kernel.store
         for cname in self.classes:
             if cname not in store.class_index:
@@ -137,7 +139,9 @@ class BuffModule(Module):
 
     # ------------------------------------------------------- device phase
     def _buff_phase(self, state: WorldState, ctx) -> WorldState:
-        table = self._frozen_table()
+        table = self._table
+        if table is None:  # phase traced before after_init (bare kernel)
+            return state
         for cname, rec_cols in self._rec_cols.items():
             cs = state.classes[cname]
             buf = cs.records[BUFF_RECORD]

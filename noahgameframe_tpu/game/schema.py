@@ -178,6 +178,33 @@ def standard_registry(extra: Optional[Iterable[ClassDef]] = None) -> ClassRegist
             records=[_comm_property_record(), _buff_record()],
         )
     )
+    # social container entities: team/guild objects the OBJECT-typed
+    # TeamID/GuildID player properties point at (the reference likewise
+    # models Guild as an entity class)
+    reg.define(
+        ClassDef(
+            name="Team",
+            parent="IObject",
+            properties=[
+                prop("Name", "string", public=True, private=True),
+                prop("LeaderID", "object", public=True, private=True),
+                prop("MemberCount", "int", public=True, private=True),
+            ],
+        )
+    )
+    reg.define(
+        ClassDef(
+            name="Guild",
+            parent="IObject",
+            properties=[
+                prop("Name", "string", public=True, private=True, save=True),
+                prop("LeaderID", "object", public=True, private=True, save=True),
+                prop("MemberCount", "int", public=True, private=True),
+                prop("GuildLevel", "int", public=True, private=True, save=True),
+                prop("Notice", "string", public=True, private=True, save=True),
+            ],
+        )
+    )
     # item/equip config class (reference Item.xlsx → Class/Item.xml):
     # consumables carry ItemType/SubType/AwardValue, equips carry the
     # stat columns EquipModule folds into the NPG_EQUIP group

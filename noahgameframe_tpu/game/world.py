@@ -24,6 +24,16 @@ from .buff import BuffModule
 from .combat import CombatModule, SkillModule
 from .hero import HeroModule
 from .items import EquipModule, ItemModule, PackModule
+from .social import (
+    FriendModule,
+    GmModule,
+    GuildModule,
+    MailModule,
+    PvpMatchModule,
+    RankModule,
+    ShopModule,
+    TeamModule,
+)
 from .task import TaskModule
 from .defines import COMM_PROPERTY_RECORD, PropertyGroup, STAT_NAMES
 from .level import LevelModule
@@ -82,7 +92,8 @@ class GameWorld:
         self.skills = SkillModule()
         modules = [self.kernel, self.scene, self.property_config, self.properties, self.level, self.skills]
         self.pack = self.items = self.equip = self.heroes = self.tasks = None
-        self.buffs = None
+        self.buffs = self.team = self.mail = self.rank = self.shop = None
+        self.friends = self.guilds = self.gm = self.pvp = None
         if cfg.middleware:
             self.pack = PackModule()
             self.items = ItemModule(self.pack)
@@ -90,8 +101,18 @@ class GameWorld:
             self.heroes = HeroModule(self.properties)
             self.tasks = TaskModule(self.level)
             self.buffs = BuffModule()
+            self.team = TeamModule()
+            self.mail = MailModule(self.pack)
+            self.rank = RankModule()
+            self.shop = ShopModule(self.pack)
+            self.friends = FriendModule()
+            self.guilds = GuildModule()
+            self.gm = GmModule(self.level, self.pack)
+            self.pvp = PvpMatchModule()
             modules += [self.pack, self.items, self.equip, self.heroes,
-                        self.tasks, self.buffs]
+                        self.tasks, self.buffs, self.team, self.mail,
+                        self.rank, self.shop, self.friends, self.guilds,
+                        self.gm, self.pvp]
         self.movement = None
         self.combat = None
         self.regen = None

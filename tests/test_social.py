@@ -1,0 +1,219 @@
+"""Social/meta systems: team, mail, rank, shop, friends, guild, GM, PVP
+matchmaking (SURVEY §2.8 NFCGSTeamModule/NFCRankModule/NFCGmModule/
+NFCGSPVPMatchModule, §2.9 NFMidWare)."""
+
+from __future__ import annotations
+
+import pytest
+
+from noahgameframe_tpu.core.datatypes import Guid, NULL_GUID
+from noahgameframe_tpu.game import GameWorld, ItemType, WorldConfig
+
+
+@pytest.fixture(scope="module")
+def world():
+    w = GameWorld(WorldConfig(combat=False, movement=False, regen=False,
+                              npc_capacity=16, player_capacity=16)).start()
+    w.scene.create_scene(1)
+    w.kernel.elements.add_element("Item", "apple", {
+        "ItemType": int(ItemType.ITEM), "BuyPrice": 5, "SalePrice": 2})
+    return w
+
+
+def mk_player(world, name):
+    return world.kernel.create_object(
+        "Player", {"Name": name, "Account": name.lower()}, scene=1, group=0)
+
+
+# ---------------------------------------------------------------- team
+
+
+def test_team_lifecycle(world):
+    a, b, c = (mk_player(world, n) for n in ("Ta", "Tb", "Tc"))
+    t = world.team
+    tid = t.create_team(a)
+    assert tid is not None
+    assert t.create_team(a) is None  # already in a team
+    assert t.join(tid, b)
+    assert not t.join(tid, b)  # no double join
+    assert world.kernel.get_property(b, "TeamID") == tid
+    assert t.team_of(b).leader == a
+    # leader leaves -> leadership passes
+    assert t.leave(a)
+    assert t.team_of(b).leader == b
+    assert world.kernel.get_property(a, "TeamID") == NULL_GUID
+    assert t.join(t.team_of(b).team_id, c)
+    assert t.disband(b)
+    assert t.team_of(c) is None
+
+
+# ---------------------------------------------------------------- mail
+
+
+def test_mail_send_read_draw(world):
+    p = mk_player(world, "MailGuy")
+    m = world.mail
+    mid = m.send("mailguy", "system", "welcome", "hi",
+                 gold=50, items={"apple": 3})
+    box = m.mailbox("mailguy")
+    assert len(box) == 1 and not box[0].read
+    assert m.read("mailguy", mid).title == "welcome"
+    g0 = int(world.kernel.get_property(p, "Gold"))
+    assert m.draw("mailguy", mid, p)
+    assert not m.draw("mailguy", mid, p)  # attachments only once
+    assert int(world.kernel.get_property(p, "Gold")) == g0 + 50
+    assert world.pack.item_count(p, "apple") == 3
+    assert m.delete("mailguy", mid)
+    assert m.mailbox("mailguy") == []
+
+
+# ---------------------------------------------------------------- rank
+
+
+def test_rank_lists(world):
+    r = world.rank
+    for name, score in (("a", 30), ("b", 50), ("c", 50), ("d", 10)):
+        r.update("level", name, score)
+    assert r.top("level", 2) == [("b", 50), ("c", 50)]
+    assert r.rank_of("level", "b") == 1
+    assert r.rank_of("level", "c") == 2  # stable tie-break by key
+    assert r.rank_of("level", "d") == 4
+    r.update("level", "d", 99)
+    assert r.rank_of("level", "d") == 1
+    r.remove("level", "d")
+    assert r.score("level", "d") is None
+
+
+# ---------------------------------------------------------------- shop
+
+
+def test_shop_buy_sell(world):
+    p = mk_player(world, "Shopper")
+    world.kernel.set_property(p, "Gold", 12)
+    assert world.shop.buy(p, "apple", 2)  # 10 gold
+    assert int(world.kernel.get_property(p, "Gold")) == 2
+    assert world.pack.item_count(p, "apple") == 2
+    assert not world.shop.buy(p, "apple", 1)  # can't afford
+    assert world.shop.sell(p, "apple", 2)  # 4 gold back
+    assert int(world.kernel.get_property(p, "Gold")) == 6
+    assert world.pack.item_count(p, "apple") == 0
+
+
+# ---------------------------------------------------------------- friends
+
+
+def test_friend_lists_and_blocks(world):
+    f = world.friends
+    assert f.add_friend("ann", "bob")
+    assert not f.add_friend("ann", "bob")  # already friends
+    assert not f.add_friend("ann", "ann")  # not yourself
+    assert f.friends("bob") == ["ann"]  # mutual
+    f.block("bob", "ann")
+    assert f.friends("bob") == [] and f.friends("ann") == []
+    assert not f.add_friend("ann", "bob")  # blocked
+    f.unblock("bob", "ann")
+    assert f.add_friend("ann", "bob")
+
+
+# ---------------------------------------------------------------- guild
+
+
+def test_guild_lifecycle(world):
+    a, b = mk_player(world, "Ga"), mk_player(world, "Gb")
+    g = world.guilds
+    gid = g.create_guild(a, "Knights")
+    assert gid is not None
+    assert g.create_guild(b, "Knights") is None  # name taken
+    assert g.join(gid, b)
+    assert world.kernel.get_property(b, "GuildID") == gid
+    assert g.find_by_name("Knights").members == [a, b]
+    assert g.leave(a)
+    assert g.guild_of(b).leader == b
+    assert g.leave(b)
+    assert g.find_by_name("Knights") is None  # empty guild dissolves
+
+
+# ---------------------------------------------------------------- GM
+
+
+def test_gm_commands_gated(world):
+    p = mk_player(world, "Op")
+    k = world.kernel
+    assert not world.gm.handle_command(p, "/gold 100")  # GMLevel 0
+    k.set_property(p, "GMLevel", 1)
+    g0 = int(k.get_property(p, "Gold"))
+    assert world.gm.handle_command(p, "/gold 100")
+    assert int(k.get_property(p, "Gold")) == g0 + 100
+    assert world.gm.handle_command(p, "/level 9")
+    assert int(k.get_property(p, "Level")) == 9
+    assert world.gm.handle_command(p, "/item apple 4")
+    assert world.pack.item_count(p, "apple") >= 4
+    assert not world.gm.handle_command(p, "hello")  # not a command
+    assert not world.gm.handle_command(p, "/nosuch")
+
+
+# ---------------------------------------------------------------- PVP
+
+
+def test_pvp_matchmaking_window_and_widening(world):
+    pvp = world.pvp
+    a, b, c = (mk_player(world, n) for n in ("Pa", "Pb", "Pc"))
+    assert pvp.join_queue(a, 1000, now=0.0)
+    assert not pvp.join_queue(a, 1000, now=0.0)  # one ticket each
+    assert pvp.join_queue(b, 1050, now=0.0)
+    assert pvp.join_queue(c, 5000, now=0.0)
+    pairs = pvp.match_once(now=0.0)
+    assert pairs == [(a, b)]  # within the 100 window; c unmatched
+    assert [t.player for t in pvp.queue] == [c]
+    # a lonely ticket matches once the window widens with wait time
+    d = mk_player(world, "Pd")
+    pvp.join_queue(d, 5900, now=0.0)
+    assert pvp.match_once(now=0.0) == []
+    widened = pvp.match_once(now=20.0)  # 100 + 50*20 = 1100 >= gap 900
+    assert widened == [(c, d)]
+    assert pvp.queue == []
+
+
+def test_destroyed_member_auto_leaves(world):
+    """Entity destruction removes it from team/guild (no stale guids)."""
+    a, b = mk_player(world, "Da"), mk_player(world, "Db")
+    tid = world.team.create_team(a)
+    world.team.join(tid, b)
+    world.kernel.destroy_object(a)
+    t = world.team.team_of(b)
+    assert t is not None and a not in t.members
+    assert t.leader == b  # leadership passed before the entity vanished
+    assert world.team.leave(b)  # no KeyError on later ops
+
+
+def test_mail_draw_fails_whole_on_full_bag(world):
+    p = mk_player(world, "FullBag")
+    # fill the 64-row bag with distinct stackables
+    for i in range(64):
+        assert world.pack.create_item(p, f"junk_{i}", 1)
+    mid = world.mail.send("fullbag", "sys", "loot", gold=10,
+                          items={"apple": 1})
+    g0 = int(world.kernel.get_property(p, "Gold"))
+    assert not world.mail.draw("fullbag", mid, p)
+    # nothing delivered, mail still claimable, gold untouched
+    assert int(world.kernel.get_property(p, "Gold")) == g0
+    assert not world.mail.mailbox("fullbag")[0].drawn
+    world.pack.delete_item(p, "junk_0", 1)
+    assert world.mail.draw("fullbag", mid, p)
+
+
+def test_shop_missing_price_not_free(world):
+    p = mk_player(world, "Cheapo")
+    world.kernel.elements.add_element("Item", "priceless", {})
+    world.kernel.set_property(p, "Gold", 1000)
+    assert world.shop.price_of("priceless") is None
+    assert not world.shop.buy(p, "priceless")
+    assert world.pack.item_count(p, "priceless") == 0
+
+
+def test_gm_malformed_args_return_false(world):
+    p = mk_player(world, "Gm2")
+    world.kernel.set_property(p, "GMLevel", 1)
+    assert not world.gm.handle_command(p, "/level abc")
+    assert not world.gm.handle_command(p, "/kill not-a-guid")
+    assert not world.gm.handle_command(p, "/gold")
