@@ -1,0 +1,48 @@
+"""Platform forcing for driver/test entry points.
+
+This container's sitecustomize registers a tunnelled-TPU ("axon") PJRT
+backend at interpreter startup and force-updates jax's config to
+``jax_platforms="axon,cpu"`` — overriding any JAX_PLATFORMS env var.  So
+forcing the CPU platform needs BOTH the env vars (for child processes /
+pre-import) and a post-import ``jax.config.update`` (for this process).
+The axon client init can hang indefinitely when the tunnel is
+unreachable, which is why every CPU-only entry point must call this
+before its first backend touch (round-1 driver failure mode).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu(n_devices: int | None = None):
+    """Force jax onto the CPU platform, optionally with `n_devices`
+    virtual devices.  Safe to call whether or not jax was already
+    imported; if backends were already initialised they are cleared.
+    Returns the jax module."""
+    if n_devices is not None:
+        flag = f"--xla_force_host_platform_device_count={n_devices}"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags
+            )
+        else:
+            flags = (flags + " " + flag).strip()
+        os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if _xb.backends_are_initialized():
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+    except Exception:
+        pass
+    return jax
