@@ -31,7 +31,19 @@ from ..net.wire import (
     ObjectPropertyFloat,
     ObjectPropertyInt,
     ObjectPropertyList,
+    ObjectPropertyObject,
+    ObjectPropertyString,
+    ObjectPropertyVector2,
+    ObjectPropertyVector3,
+    ObjectRecordAddRow,
+    ObjectRecordFloat,
+    ObjectRecordInt,
     ObjectRecordList,
+    ObjectRecordObject,
+    ObjectRecordRemove,
+    ObjectRecordString,
+    ObjectRecordSwap,
+    ObjectRecordVector3,
     Position,
     ReqAccountLogin,
     ReqAckPlayerChat,
@@ -105,11 +117,21 @@ class GameClient:
         h[int(MsgID.ACK_OBJECT_ENTRY)] = self._on_object_entry
         h[int(MsgID.ACK_OBJECT_LEAVE)] = self._on_object_leave
         h[int(MsgID.ACK_OBJECT_PROPERTY_ENTRY)] = self._on_property_list
-        h[int(MsgID.ACK_PROPERTY_VECTOR3)] = self._on_property_list
-        h[int(MsgID.ACK_PROPERTY_STRING)] = self._on_property_list
         h[int(MsgID.ACK_OBJECT_RECORD_ENTRY)] = self._on_record_list
         h[int(MsgID.ACK_PROPERTY_INT)] = self._on_property_int
         h[int(MsgID.ACK_PROPERTY_FLOAT)] = self._on_property_float
+        h[int(MsgID.ACK_PROPERTY_STRING)] = self._on_property_string
+        h[int(MsgID.ACK_PROPERTY_OBJECT)] = self._on_property_object
+        h[int(MsgID.ACK_PROPERTY_VECTOR2)] = self._on_property_vector2
+        h[int(MsgID.ACK_PROPERTY_VECTOR3)] = self._on_property_vector3
+        h[int(MsgID.ACK_ADD_ROW)] = self._on_record_add_row
+        h[int(MsgID.ACK_REMOVE_ROW)] = self._on_record_remove
+        h[int(MsgID.ACK_SWAP_ROW)] = self._on_record_swap
+        h[int(MsgID.ACK_RECORD_INT)] = self._on_record_int
+        h[int(MsgID.ACK_RECORD_FLOAT)] = self._on_record_float
+        h[int(MsgID.ACK_RECORD_STRING)] = self._on_record_string
+        h[int(MsgID.ACK_RECORD_OBJECT)] = self._on_record_object
+        h[int(MsgID.ACK_RECORD_VECTOR3)] = self._on_record_vector3
         h[int(MsgID.ACK_MOVE)] = self._on_move
         h[int(MsgID.ACK_CHAT)] = self._on_chat
         h[int(MsgID.ACK_SKILL_OBJECTX)] = self._on_skill
@@ -287,16 +309,132 @@ class GameClient:
         for p in pl.property_list:
             o.properties[p.property_name.decode()] = float(p.data)
 
+    def _on_property_string(self, base: MsgBase) -> None:
+        pl = ObjectPropertyString.decode(base.msg_data)
+        o = self._obj(pl.player_id)
+        for p in pl.property_list:
+            o.properties[p.property_name.decode()] = p.data.decode(
+                "utf-8", "replace"
+            )
+
+    def _on_property_object(self, base: MsgBase) -> None:
+        pl = ObjectPropertyObject.decode(base.msg_data)
+        o = self._obj(pl.player_id)
+        for p in pl.property_list:
+            o.properties[p.property_name.decode()] = self._ident_tuple(p.data)
+
+    def _on_property_vector2(self, base: MsgBase) -> None:
+        pl = ObjectPropertyVector2.decode(base.msg_data)
+        o = self._obj(pl.player_id)
+        for p in pl.property_list:
+            v = p.data
+            o.properties[p.property_name.decode()] = (
+                (v.x, v.y) if v is not None else (0.0, 0.0)
+            )
+
+    def _on_property_vector3(self, base: MsgBase) -> None:
+        pl = ObjectPropertyVector3.decode(base.msg_data)
+        o = self._obj(pl.player_id)
+        for p in pl.property_list:
+            v = p.data
+            o.properties[p.property_name.decode()] = (
+                (v.x, v.y, v.z) if v is not None else (0.0, 0.0, 0.0)
+            )
+
+    @staticmethod
+    def _ident_tuple(i: Optional[Ident]) -> Tuple[int, int]:
+        return (i.svrid, i.index) if i is not None else (0, 0)
+
+    def _absorb_row_struct(self, cells: Dict, rowmsg) -> None:
+        """Fold one RecordAddRowStruct's cells (every column type) into a
+        mirror record."""
+        for c in rowmsg.record_int_list:
+            cells[(c.row, c.col)] = int(c.data)
+        for c in rowmsg.record_float_list:
+            cells[(c.row, c.col)] = float(c.data)
+        for c in rowmsg.record_string_list:
+            cells[(c.row, c.col)] = c.data.decode("utf-8", "replace")
+        for c in rowmsg.record_object_list:
+            cells[(c.row, c.col)] = self._ident_tuple(c.data)
+        for c in rowmsg.record_vector2_list:
+            v = c.data
+            cells[(c.row, c.col)] = (v.x, v.y) if v is not None else (0.0, 0.0)
+        for c in rowmsg.record_vector3_list:
+            v = c.data
+            cells[(c.row, c.col)] = (
+                (v.x, v.y, v.z) if v is not None else (0.0, 0.0, 0.0)
+            )
+
     def _on_record_list(self, base: MsgBase) -> None:
         rl = ObjectRecordList.decode(base.msg_data)
         o = self._obj(rl.player_id)
         for rec in rl.record_list:
             cells = o.records.setdefault(rec.record_name.decode(), {})
             for rowmsg in rec.row_struct:
-                for c in rowmsg.record_int_list:
-                    cells[(c.row, c.col)] = int(c.data)
-                for c in rowmsg.record_float_list:
-                    cells[(c.row, c.col)] = float(c.data)
+                self._absorb_row_struct(cells, rowmsg)
+
+    # ------------------------------------------------- per-change record sync
+    def _rec_cells(self, base_pid: Optional[Ident], record_name: bytes) -> Dict:
+        o = self._obj(base_pid)
+        return o.records.setdefault(record_name.decode(), {})
+
+    def _on_record_add_row(self, base: MsgBase) -> None:
+        msg = ObjectRecordAddRow.decode(base.msg_data)
+        cells = self._rec_cells(msg.player_id, msg.record_name)
+        for rowmsg in msg.row_data:
+            self._absorb_row_struct(cells, rowmsg)
+
+    def _on_record_remove(self, base: MsgBase) -> None:
+        msg = ObjectRecordRemove.decode(base.msg_data)
+        cells = self._rec_cells(msg.player_id, msg.record_name)
+        gone = set(msg.remove_row)
+        for key in [k for k in cells if k[0] in gone]:
+            del cells[key]
+
+    def _on_record_swap(self, base: MsgBase) -> None:
+        msg = ObjectRecordSwap.decode(base.msg_data)
+        cells = self._rec_cells(msg.player_id, msg.origin_record_name)
+        a, b = msg.row_origin, msg.row_target
+        moved = {}
+        for (r, c) in list(cells):
+            if r == a:
+                moved[(b, c)] = cells.pop((r, c))
+            elif r == b:
+                moved[(a, c)] = cells.pop((r, c))
+        cells.update(moved)
+
+    def _on_record_int(self, base: MsgBase) -> None:
+        msg = ObjectRecordInt.decode(base.msg_data)
+        cells = self._rec_cells(msg.player_id, msg.record_name)
+        for c in msg.property_list:
+            cells[(c.row, c.col)] = int(c.data)
+
+    def _on_record_float(self, base: MsgBase) -> None:
+        msg = ObjectRecordFloat.decode(base.msg_data)
+        cells = self._rec_cells(msg.player_id, msg.record_name)
+        for c in msg.property_list:
+            cells[(c.row, c.col)] = float(c.data)
+
+    def _on_record_string(self, base: MsgBase) -> None:
+        msg = ObjectRecordString.decode(base.msg_data)
+        cells = self._rec_cells(msg.player_id, msg.record_name)
+        for c in msg.property_list:
+            cells[(c.row, c.col)] = c.data.decode("utf-8", "replace")
+
+    def _on_record_object(self, base: MsgBase) -> None:
+        msg = ObjectRecordObject.decode(base.msg_data)
+        cells = self._rec_cells(msg.player_id, msg.record_name)
+        for c in msg.property_list:
+            cells[(c.row, c.col)] = self._ident_tuple(c.data)
+
+    def _on_record_vector3(self, base: MsgBase) -> None:
+        msg = ObjectRecordVector3.decode(base.msg_data)
+        cells = self._rec_cells(msg.player_id, msg.record_name)
+        for c in msg.property_list:
+            v = c.data
+            cells[(c.row, c.col)] = (
+                (v.x, v.y, v.z) if v is not None else (0.0, 0.0, 0.0)
+            )
 
     # ------------------------------------------------------------- gameplay
     def move_to(self, x: float, y: float, z: float = 0.0) -> None:

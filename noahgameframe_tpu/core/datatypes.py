@@ -121,6 +121,17 @@ class GuidAllocator:
             self._last = now
             return Guid(self._app_id, now)
 
+    def next_batch(self, n: int) -> list:
+        """n distinct guids under ONE lock acquisition + clock read — the
+        bulk-create fast path (create_many at 1M NPCs)."""
+        with self._lock:
+            now = int(_time.time() * 1_000_000)
+            if now <= self._last:
+                now = self._last + 1
+            self._last = now + n - 1
+            app = self._app_id
+            return [Guid(app, now + i) for i in range(n)]
+
 
 Vector2 = Tuple[float, float]
 Vector3 = Tuple[float, float, float]

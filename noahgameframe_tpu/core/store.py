@@ -35,7 +35,8 @@ map; object-valued properties store packed int32 handles
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+import enum
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,6 +58,30 @@ from .strings import StringTable
 
 HANDLE_ROW_BITS = 24
 HANDLE_ROW_MASK = (1 << HANDLE_ROW_BITS) - 1
+
+
+class RecordOp(enum.IntEnum):
+    """Per-op record event types, value-compatible with the reference's
+    NFIRecord::RecordOptype (NFIRecord.h:16-25)."""
+
+    ADD = 0
+    DEL = 1
+    SWAP = 2
+    CREATE = 3
+    UPDATE = 4
+    CLEARED = 5
+    SORT = 6
+    COVER = 7
+
+
+# (class_name, record_name, op, entity_rows, rec_row, tags): fired by the
+# host-side record mutators, batch-first — entity_rows is an int array so
+# the bulk paths (record_write_rows) cost one call, not one per entity.
+# tags is the touched-column subset for UPDATE, None for whole-row ops.
+# For SWAP, rec_row is the (origin, target) row pair.
+RecordEventFn = Callable[
+    [str, str, "RecordOp", np.ndarray, Any, Optional[Tuple[str, ...]]], None
+]
 
 
 def with_class(state: "WorldState", class_name: str, cs: "ClassState") -> "WorldState":
@@ -159,6 +184,9 @@ class _ClassHost:
         self.capacity = capacity
         self.free: List[int] = list(range(capacity - 1, -1, -1))
         self.row_guid: List[Optional[Guid]] = [None] * capacity
+        # host-side allocation bitmap: lets reconcile_deaths find device
+        # deaths with ONE vector op instead of a Python scan of every row
+        self.alloc_mask = np.zeros(capacity, bool)
         self.live_count = 0
 
     def alloc(self) -> int:
@@ -167,11 +195,28 @@ class _ClassHost:
                 f"class {self.spec.name!r} capacity {self.capacity} exhausted"
             )
         self.live_count += 1
-        return self.free.pop()
+        row = self.free.pop()
+        self.alloc_mask[row] = True
+        return row
+
+    def alloc_many(self, n: int) -> np.ndarray:
+        if n <= 0:  # free[-0:] would slice the WHOLE list
+            return np.zeros(0, np.int32)
+        if len(self.free) < n:
+            raise RuntimeError(
+                f"class {self.spec.name!r} capacity {self.capacity} exhausted "
+                f"({len(self.free)} free, {n} requested)"
+            )
+        rows = np.asarray(self.free[-n:][::-1], np.int32)
+        del self.free[-n:]
+        self.live_count += n
+        self.alloc_mask[rows] = True
+        return rows
 
     def release(self, row: int) -> None:
         self.row_guid[row] = None
         self.free.append(row)
+        self.alloc_mask[row] = False
         self.live_count -= 1
 
 
@@ -197,6 +242,9 @@ class EntityStore:
         self.class_index: Dict[str, int] = {n: i for i, n in enumerate(names)}
         self._hosts: Dict[str, _ClassHost] = {}
         self.guid_map: Dict[Guid, int] = {}  # guid -> packed handle
+        # host-path record hooks (reference NFIRecord::AddRecordHook);
+        # device-path record changes are diffed by the kernel tick instead
+        self.record_subs: List[RecordEventFn] = []
         for n in names:
             spec = registry.spec(n)
             self._hosts[n] = _ClassHost(
@@ -366,13 +414,14 @@ class EntityStore:
                 f"class {spec.name!r} capacity {host.capacity} exhausted "
                 f"({len(host.free)} free, {n} requested)"
             )
-        rows = np.asarray([host.alloc() for _ in range(n)], np.int32)
-        out_guids: List[Guid] = []
-        for i in range(n):
-            g = guids[i] if guids is not None else self.guids.next()
-            self.guid_map[g] = pack_handle(host.class_idx, int(rows[i]))
-            host.row_guid[int(rows[i])] = g
-            out_guids.append(g)
+        rows = host.alloc_many(n)
+        out_guids: List[Guid] = (
+            list(guids) if guids is not None else self.guids.next_batch(n)
+        )
+        ci = host.class_idx
+        for g, row in zip(out_guids, rows.tolist()):
+            self.guid_map[g] = pack_handle(ci, row)
+            host.row_guid[row] = g
 
         cs = state.classes[class_name]
         # fully reset the rows: banks to defaults/overrides, timers off, and
@@ -418,15 +467,20 @@ class EntityStore:
     def reconcile_deaths(self, state: WorldState, class_name: str) -> List[Guid]:
         """Sync host allocation with rows whose `alive` was cleared on
         device (in-tick deaths).  Returns the guids destroyed.  The device
-        never allocates — it only kills — so host free-lists stay exact."""
+        never allocates — it only kills — so host free-lists stay exact.
+        One vector compare against the host alloc bitmap; Python touches
+        only the dead rows (round-1: this scanned every capacity row)."""
         host = self._hosts[class_name]
         alive = np.asarray(state.classes[class_name].alive)
+        dead_rows = np.flatnonzero(host.alloc_mask & ~alive)
         dead: List[Guid] = []
-        for row, g in enumerate(host.row_guid):
-            if g is not None and not alive[row]:
-                dead.append(g)
-                del self.guid_map[g]
-                host.release(row)
+        for row in dead_rows.tolist():
+            g = host.row_guid[row]
+            if g is None:
+                continue
+            dead.append(g)
+            del self.guid_map[g]
+            host.release(row)
         return dead
 
     # -- typed property access (host control plane) -------------------------
@@ -465,6 +519,27 @@ class EntityStore:
     def _rec(self, class_name: str, record_name: str) -> RecordSpec:
         return self.spec(class_name).records[record_name]
 
+    def subscribe_records(self, fn: RecordEventFn) -> None:
+        """Register a host-path record hook (NFIRecord::AddRecordHook):
+        fired after every host record mutation with the op, the touched
+        entity rows, the record row, and (for UPDATE) the column tags."""
+        self.record_subs.append(fn)
+
+    def _fire_record(
+        self,
+        class_name: str,
+        record_name: str,
+        op: RecordOp,
+        entity_rows,
+        rec_row: int,
+        tags: Optional[Tuple[str, ...]] = None,
+    ) -> None:
+        if not self.record_subs:
+            return
+        rows = np.atleast_1d(np.asarray(entity_rows, np.int64))
+        for fn in self.record_subs:
+            fn(class_name, record_name, op, rows, rec_row, tags)
+
     def record_add_row(
         self,
         state: WorldState,
@@ -492,7 +567,11 @@ class EntityStore:
         cs = state.classes[class_name]
         rec = cs.records[record_name]
         rec = rec.replace(used=rec.used.at[row, r].set(True))
-        return with_class(state, class_name, cs.replace(records={**cs.records, record_name: rec})), r
+        state = with_class(
+            state, class_name, cs.replace(records={**cs.records, record_name: rec})
+        )
+        self._fire_record(class_name, record_name, RecordOp.ADD, row, r)
+        return state, r
 
     def record_restore_row(
         self,
@@ -515,9 +594,11 @@ class EntityStore:
         cs = state.classes[class_name]
         rec = cs.records[record_name]
         rec = rec.replace(used=rec.used.at[row, rec_row].set(True))
-        return with_class(
+        state = with_class(
             state, class_name, cs.replace(records={**cs.records, record_name: rec})
         )
+        self._fire_record(class_name, record_name, RecordOp.ADD, row, rec_row)
+        return state
 
     def record_remove_row(
         self, state: WorldState, guid: Guid, record_name: str, rec_row: int
@@ -526,7 +607,40 @@ class EntityStore:
         cs = state.classes[class_name]
         rec = cs.records[record_name]
         rec = rec.replace(used=rec.used.at[row, rec_row].set(False))
-        return with_class(state, class_name, cs.replace(records={**cs.records, record_name: rec}))
+        state = with_class(
+            state, class_name, cs.replace(records={**cs.records, record_name: rec})
+        )
+        self._fire_record(class_name, record_name, RecordOp.DEL, row, rec_row)
+        return state
+
+    def record_swap_rows(
+        self,
+        state: WorldState,
+        guid: Guid,
+        record_name: str,
+        row_origin: int,
+        row_target: int,
+    ) -> WorldState:
+        """Exchange two record rows' contents and used flags in one op
+        (reference NFCRecord::SwapRowInfo, NFCRecord.h:17-156)."""
+        class_name, row = self.row_of(guid)
+        cs = state.classes[class_name]
+        rec = cs.records[record_name]
+        pair = np.asarray([row_origin, row_target])
+        swapped = np.asarray([row_target, row_origin])
+        rec = rec.replace(
+            i32=rec.i32.at[row, pair].set(rec.i32[row, swapped]),
+            f32=rec.f32.at[row, pair].set(rec.f32[row, swapped]),
+            vec=rec.vec.at[row, pair].set(rec.vec[row, swapped]),
+            used=rec.used.at[row, pair].set(rec.used[row, swapped]),
+        )
+        state = with_class(
+            state, class_name, cs.replace(records={**cs.records, record_name: rec})
+        )
+        self._fire_record(
+            class_name, record_name, RecordOp.SWAP, row, (row_origin, row_target)
+        )
+        return state
 
     def record_set(
         self,
@@ -538,9 +652,13 @@ class EntityStore:
         value: Value,
     ) -> WorldState:
         class_name, row = self.row_of(guid)
-        return self._record_write(
+        state = self._record_write(
             state, class_name, row, record_name, rec_row, {tag: value}
         )
+        self._fire_record(
+            class_name, record_name, RecordOp.UPDATE, row, rec_row, (tag,)
+        )
+        return state
 
     def record_get(
         self, state: WorldState, guid: Guid, record_name: str, rec_row: int, tag: str
@@ -620,9 +738,14 @@ class EntityStore:
             vec = vec.at[rows[:, None], rec_row, cols[None, :]].set(staged[Bank.VEC][:, cols])
         used = rec.used.at[rows, rec_row].set(True) if mark_used else rec.used
         rec = rec.replace(i32=i32, f32=f32, vec=vec, used=used)
-        return with_class(
+        state = with_class(
             state, class_name, cs.replace(records={**cs.records, record_name: rec})
         )
+        self._fire_record(
+            class_name, record_name, RecordOp.UPDATE, rows, rec_row,
+            tuple(col_values),
+        )
+        return state
 
     def _record_write(
         self,

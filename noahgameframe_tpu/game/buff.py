@@ -74,6 +74,22 @@ class BuffModule(Module):
         rows = self._stats or [[0] * len(STAT_NAMES)]
         self._table = jnp.asarray(np.asarray(rows, np.int32))
 
+    # ------------------------------------------------- checkpoint/resume
+    def checkpoint_state(self) -> dict:
+        return {
+            "defs": self._defs,
+            "durations": self._durations,
+            "stats": self._stats,
+        }
+
+    def restore_state(self, data: dict) -> None:
+        self._defs = {k: int(v) for k, v in data.get("defs", {}).items()}
+        self._durations = [float(d) for d in data.get("durations", [])]
+        self._stats = [[int(x) for x in row] for row in data.get("stats", [])]
+        self._rebuild_table()
+        if self.kernel is not None:
+            self.kernel.invalidate()
+
     def after_init(self) -> None:
         self._rebuild_table()
         store = self.kernel.store

@@ -28,6 +28,18 @@ class HeroModule(Module):
         self.exp_per_level = exp_per_level
         self._fight_hero: Dict[Guid, int] = {}  # owner -> hero record row
 
+    # ------------------------------------------------- checkpoint/resume
+    def checkpoint_state(self) -> dict:
+        return {"fight_hero": {str(g): row for g, row in self._fight_hero.items()}}
+
+    def restore_state(self, data: dict) -> None:
+        from ..core.datatypes import Guid as _Guid
+
+        self._fight_hero = {
+            _Guid.parse(g): int(row)
+            for g, row in data.get("fight_hero", {}).items()
+        }
+
     # ------------------------------------------------------- collection
     def add_hero(self, guid: Guid, config_id: str) -> Optional[int]:
         """Dedupe by ConfigID; returns the hero's record row."""

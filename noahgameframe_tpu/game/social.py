@@ -134,6 +134,33 @@ class _MembershipModule(Module):
         del self.groups[g.group_id]
         self.kernel.destroy_object(g.group_id)
 
+    # ------------------------------------------------- checkpoint/resume
+    def checkpoint_state(self) -> dict:
+        return {
+            "groups": [
+                {
+                    "group_id": str(g.group_id),
+                    "leader": str(g.leader),
+                    "members": [str(m) for m in g.members],
+                    "capacity": g.capacity,
+                    "name": g.name,
+                }
+                for g in self.groups.values()
+            ]
+        }
+
+    def restore_state(self, data: dict) -> None:
+        self.groups = {}
+        for gd in data.get("groups", []):
+            gid = Guid.parse(gd["group_id"])
+            self.groups[gid] = GroupInfo(
+                gid,
+                Guid.parse(gd["leader"]),
+                [Guid.parse(m) for m in gd["members"]],
+                int(gd["capacity"]),
+                gd.get("name", ""),
+            )
+
 
 # ===================================================================== team
 
@@ -247,6 +274,23 @@ class MailModule(Module):
         self._boxes[account] = [m for m in box if m.mail_id != mail_id]
         return len(self._boxes[account]) != n
 
+    # ------------------------------------------------- checkpoint/resume
+    def checkpoint_state(self) -> dict:
+        return {
+            "next_id": self._next_id,
+            "boxes": {
+                acct: [dataclasses.asdict(m) for m in box]
+                for acct, box in self._boxes.items()
+            },
+        }
+
+    def restore_state(self, data: dict) -> None:
+        self._next_id = int(data.get("next_id", 1))
+        self._boxes = {
+            acct: [Mail(**m) for m in box]
+            for acct, box in data.get("boxes", {}).items()
+        }
+
 
 # ===================================================================== rank
 
@@ -283,6 +327,16 @@ class RankModule(Module):
         my = entries[key]
         return 1 + sum(1 for k, v in entries.items()
                        if v > my or (v == my and k < key))
+
+    # ------------------------------------------------- checkpoint/resume
+    def checkpoint_state(self) -> dict:
+        return {"lists": self._lists}
+
+    def restore_state(self, data: dict) -> None:
+        self._lists = {
+            ln: {k: int(v) for k, v in entries.items()}
+            for ln, entries in data.get("lists", {}).items()
+        }
 
 
 # ===================================================================== shop
@@ -386,6 +440,14 @@ class FriendModule(Module):
     def blocked(self, account: str) -> List[str]:
         return list(self._blocked.get(account, []))
 
+    # ------------------------------------------------- checkpoint/resume
+    def checkpoint_state(self) -> dict:
+        return {"friends": self._friends, "blocked": self._blocked}
+
+    def restore_state(self, data: dict) -> None:
+        self._friends = {k: list(v) for k, v in data.get("friends", {}).items()}
+        self._blocked = {k: list(v) for k, v in data.get("blocked", {}).items()}
+
 
 # ===================================================================== guild
 
@@ -427,6 +489,10 @@ class GuildModule(_MembershipModule):
     def _dissolve(self, g: GroupInfo) -> None:
         self._by_name.pop(g.name, None)
         super()._dissolve(g)
+
+    def restore_state(self, data: dict) -> None:
+        super().restore_state(data)
+        self._by_name = {g.name: gid for gid, g in self.groups.items() if g.name}
 
 
 # ===================================================================== GM

@@ -60,7 +60,9 @@ class WorldConfig:
     movement: bool = True
     regen: bool = True
     middleware: bool = True  # items/hero/task/buff stack
-    diff_flags: tuple = ("public", "upload")
+    # private is included so owner-only state (EXP, Gold, bag counters)
+    # reaches its own client (GetBroadCastObject: Private -> self)
+    diff_flags: tuple = ("public", "private", "upload")
 
 
 class GameWorld:
@@ -143,6 +145,23 @@ class GameWorld:
     def start(self) -> "GameWorld":
         self.pm.start()
         return self
+
+    @property
+    def all_modules(self):
+        """Every registered module — the `modules` argument for
+        persist.checkpoint save_world/load_world so host state (teams,
+        guilds, mail, ranks, buff defs) survives a resume."""
+        return list(self.pm.modules.values())
+
+    def save(self, path) -> None:
+        from ..persist.checkpoint import save_world
+
+        save_world(self.kernel, path, modules=self.all_modules)
+
+    def load(self, path) -> None:
+        from ..persist.checkpoint import load_world
+
+        load_world(self.kernel, path, modules=self.all_modules)
 
     # -- seeding --------------------------------------------------------------
 

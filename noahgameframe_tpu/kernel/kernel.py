@@ -52,6 +52,10 @@ class ObjectEvent(enum.IntEnum):
 
 ClassEventFn = Callable[[Guid, str, "ObjectEvent"], None]
 PropertyEventFn = Callable[[str, str, np.ndarray], None]  # (class, prop, changed_rows)
+# (class, record, codes[C, R] int8) — 0 none, 1 added, 2 removed, 3 updated
+RecordDiffFn = Callable[[str, str, np.ndarray], None]
+
+REC_NONE, REC_ADDED, REC_REMOVED, REC_UPDATED = 0, 1, 2, 3
 
 
 class TickCtx:
@@ -104,6 +108,13 @@ class TickOutputs:
     died: Dict[str, jnp.ndarray]  # class -> [C] bool
     died_count: Dict[str, jnp.ndarray]  # class -> scalar
     events: List[DeviceEvent]
+    # class -> record -> [C, R] int8 row-change codes (REC_* constants);
+    # only populated for (class, record) pairs with a registered
+    # record-diff subscriber — unsubscribed records cost zero device work
+    rec_diff: Dict[str, Dict[str, jnp.ndarray]] = dataclasses.field(
+        default_factory=dict
+    )
+    rec_diff_count: Dict[str, jnp.ndarray] = dataclasses.field(default_factory=dict)
 
 
 class Kernel(Module):
@@ -141,6 +152,7 @@ class Kernel(Module):
         self._class_event_subs: List[ClassEventFn] = []
         self._class_event_by_class: Dict[str, List[ClassEventFn]] = {}
         self._prop_event_subs: Dict[Tuple[str, str], List[PropertyEventFn]] = {}
+        self._rec_event_subs: Dict[Tuple[str, str], List[RecordDiffFn]] = {}
         self._pending_destroy: List[Guid] = []
         self._event_meta: List[Tuple[int, str, Tuple[str, ...]]] = []
         self.tick_count = 0
@@ -199,6 +211,8 @@ class Kernel(Module):
 
         diff: Dict[str, Dict[str, jnp.ndarray]] = {}
         diff_count: Dict[str, jnp.ndarray] = {}
+        rec_diff: Dict[str, Dict[str, jnp.ndarray]] = {}
+        rec_diff_count: Dict[str, jnp.ndarray] = {}
         died: Dict[str, jnp.ndarray] = {}
         died_count: Dict[str, jnp.ndarray] = {}
         for cname in self.store.class_order:
@@ -231,6 +245,40 @@ class Kernel(Module):
             if masks:
                 diff[cname] = masks
                 diff_count[cname] = total
+            # record-row diffs: add/remove/update codes per (entity, row),
+            # only for subscribed records (device phases mutate records —
+            # buff expiry, stat groups — and those changes must reach the
+            # same sync spine as host record ops;
+            # reference NFCRecord per-op callbacks, NFCRecord.h:17-156)
+            rec_codes: Dict[str, jnp.ndarray] = {}
+            rec_total = jnp.zeros((), jnp.int32)
+            for rname in spec.record_order:
+                if (cname, rname) not in self._rec_event_subs:
+                    continue
+                rs = spec.records[rname]
+                orec, nrec = oc.records[rname], nc.records[rname]
+                cell_changed = jnp.zeros(nrec.used.shape, bool)
+                if rs.n_i32:
+                    cell_changed |= jnp.any(orec.i32 != nrec.i32, axis=-1)
+                if rs.n_f32:
+                    cell_changed |= jnp.any(orec.f32 != nrec.f32, axis=-1)
+                if rs.n_vec:
+                    cell_changed |= jnp.any(orec.vec != nrec.vec, axis=(-2, -1))
+                code = jnp.where(
+                    ~orec.used & nrec.used,
+                    REC_ADDED,
+                    jnp.where(
+                        orec.used & ~nrec.used,
+                        REC_REMOVED,
+                        jnp.where(nrec.used & cell_changed, REC_UPDATED, REC_NONE),
+                    ),
+                ).astype(jnp.int8)
+                code = code * nc.alive[:, None].astype(jnp.int8)
+                rec_codes[rname] = code
+                rec_total = rec_total + jnp.sum(code != 0, dtype=jnp.int32)
+            if rec_codes:
+                rec_diff[cname] = rec_codes
+                rec_diff_count[cname] = rec_total
             d = oc.alive & ~nc.alive
             died[cname] = d
             died_count[cname] = jnp.sum(d, dtype=jnp.int32)
@@ -252,6 +300,9 @@ class Kernel(Module):
                 jnp.stack([diff_count[c] for c in sorted(diff_count)])
                 if diff_count
                 else jnp.zeros((0,), jnp.int32),
+                jnp.stack([rec_diff_count[c] for c in sorted(rec_diff_count)])
+                if rec_diff_count
+                else jnp.zeros((0,), jnp.int32),
                 jnp.stack(
                     [jnp.sum(e.mask, dtype=jnp.int32) for e in ctx.emitted]
                 )
@@ -263,6 +314,8 @@ class Kernel(Module):
             "fired": fired,
             "diff": diff,
             "diff_count": diff_count,
+            "rec_diff": rec_diff,
+            "rec_diff_count": rec_diff_count,
             "died": died,
             "died_count": died_count,
             "events": [(e.mask, e.params) for e in ctx.emitted],
@@ -290,6 +343,8 @@ class Kernel(Module):
             fired=raw["fired"],
             diff=raw["diff"],
             diff_count=raw["diff_count"],
+            rec_diff=raw["rec_diff"],
+            rec_diff_count=raw["rec_diff_count"],
             died=raw["died"],
             died_count=raw["died_count"],
             events=[
@@ -336,7 +391,10 @@ class Kernel(Module):
         died_counts = summary[:n_cls]
         diff_keys = sorted(out.diff_count)
         diff_counts = dict(zip(diff_keys, summary[n_cls : n_cls + len(diff_keys)]))
-        event_counts = summary[n_cls + len(diff_keys) :]
+        off = n_cls + len(diff_keys)
+        rec_keys = sorted(out.rec_diff_count)
+        rec_counts = dict(zip(rec_keys, summary[off : off + len(rec_keys)]))
+        event_counts = summary[off + len(rec_keys) :]
         # device-emitted events FIRST — entities that died this tick must
         # still deliver their events (the reference fires events before
         # destroy), so guid identities are intact here
@@ -369,6 +427,18 @@ class Kernel(Module):
                 if rows.size:
                     for fn in fns:
                         fn(cname, pname, rows)
+        # record-diff subscribers (device-path record mutations)
+        if self._rec_event_subs:
+            for (cname, rname), fns in self._rec_event_subs.items():
+                if int(rec_counts.get(cname, 0)) == 0:
+                    continue
+                codes_dev = out.rec_diff.get(cname, {}).get(rname)
+                if codes_dev is None:
+                    continue
+                codes = np.asarray(codes_dev)
+                if codes.any():
+                    for fn in fns:
+                        fn(cname, rname, codes)
 
     # -- object lifecycle (host control plane) ------------------------------
 
@@ -482,6 +552,27 @@ class Kernel(Module):
         # diff extraction depends only on diff_flags (static), so no
         # recompilation is needed when subscribers change
         self._prop_event_subs.setdefault((class_name, prop_name), []).append(fn)
+
+    def register_record_diff(
+        self, class_name: str, record_name: str, fn: RecordDiffFn
+    ) -> None:
+        """Subscribe to a record's device-path changes; called after each
+        tick with an int8 [C, R] code array (REC_ADDED/REMOVED/UPDATED).
+        The diff is computed on device ONLY for subscribed records, so
+        registration invalidates the compiled tick."""
+        spec = self.store.spec(class_name)
+        if record_name not in spec.records:
+            raise KeyError(f"{class_name!r} has no record {record_name!r}")
+        key = (class_name, record_name)
+        first = key not in self._rec_event_subs
+        self._rec_event_subs.setdefault(key, []).append(fn)
+        if first:
+            self.invalidate()
+
+    def subscribe_record_host(self, fn) -> None:
+        """Host-path per-op record hook (store mutators; reference
+        NFIRecord::AddRecordHook) — see EntityStore.subscribe_records."""
+        self.store.subscribe_records(fn)
 
     def _fire_class_event(self, guid: Guid, class_name: str, ev: ObjectEvent) -> None:
         for fn in self._class_event_by_class.get(class_name, ()):

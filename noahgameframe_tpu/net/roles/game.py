@@ -25,9 +25,20 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ...core.datatypes import Bank, DataType, Guid
+from ...core.store import RecordOp
 from ...game.world import GameWorld, WorldConfig
-from ...kernel.kernel import ObjectEvent, TickOutputs
-from ...persist.codec import serialize_properties, serialize_records
+from ...kernel.kernel import (
+    ObjectEvent,
+    REC_ADDED,
+    REC_REMOVED,
+    REC_UPDATED,
+    TickOutputs,
+)
+from ...persist.codec import (
+    record_row_struct,
+    serialize_properties,
+    serialize_records,
+)
 from ..defines import EventCode, MsgID, ServerType
 from ..transport import EV_DISCONNECTED
 from ..wire import (
@@ -41,16 +52,32 @@ from ..wire import (
     ObjectPropertyFloat,
     ObjectPropertyInt,
     ObjectPropertyList,
+    ObjectPropertyObject,
+    ObjectPropertyString,
+    ObjectPropertyVector2,
+    ObjectPropertyVector3,
+    ObjectRecordAddRow,
     ObjectRecordBase,
+    ObjectRecordFloat,
+    ObjectRecordInt,
     ObjectRecordList,
+    ObjectRecordObject,
+    ObjectRecordRemove,
+    ObjectRecordString,
+    ObjectRecordVector3,
     PlayerEntryInfo,
     PropertyFloat,
     PropertyInt,
+    PropertyObject,
     PropertyString,
+    PropertyVector2,
     PropertyVector3,
     RecordAddRowStruct,
     RecordFloat,
     RecordInt,
+    RecordObject,
+    RecordString,
+    RecordVector3,
     ReqAckPlayerChat,
     ReqAckPlayerMove,
     ReqAckSwapScene,
@@ -60,6 +87,7 @@ from ..wire import (
     ReqEnterGameServer,
     ReqRoleList,
     RoleLiteInfo,
+    Vector2,
     Vector3,
     ident_key as _ident_key,
     unwrap,
@@ -97,6 +125,7 @@ class GameRole(ServerRole):
         data_agent=None,
         role_store=None,
         autosave_seconds: float = 30.0,
+        cross_server_sync: bool = True,
     ) -> None:
         self.game_world = world if world is not None else GameWorld(
             WorldConfig(combat=False, movement=False, regen=True)
@@ -128,6 +157,16 @@ class GameRole(ServerRole):
             register_msg=MsgID.GTW_GAME_REGISTERED,
             refresh_msg=MsgID.STS_SERVER_REPORT,
         )
+        # world relay: public Player state forwarded up; remote games' sync
+        # delivered to local clients (cross-game visibility without the
+        # reference's world-side object mirror — the batched messages relay
+        # verbatim; NFCWorldNet_ServerModule.cpp:600-830)
+        self.cross_server_sync = cross_server_sync
+        if cross_server_sync:  # gate BOTH directions (isolated realms)
+            from .world import CROSS_SYNC_MSGS
+
+            for msg in CROSS_SYNC_MSGS:
+                self.world_link.on(msg, self._on_world_sync)
         # a playable default stat table when the deployment didn't load one
         # (reference ships Property*.xlsx configs; LevelModule refreshes the
         # JOBLEVEL stat row from it on level-up)
@@ -146,16 +185,36 @@ class GameRole(ServerRole):
             self.data_agent.bind(self.kernel)
         self.kernel.register_class_event(self._on_class_event, "Player")
         self.kernel.register_class_event(self._on_npc_event, "NPC")
-        # subscribe every public property of the synced classes; the kernel
-        # fires these for host writes synchronously AND from the device
-        # diff masks after each tick — one mechanism for the whole spine
+        # subscribe every public OR private property of the synced classes;
+        # the kernel fires these for host writes synchronously AND from the
+        # device diff masks after each tick — one mechanism for the whole
+        # spine.  Public changes broadcast to the (scene, group); private-
+        # only changes go to the owner's client (GetBroadCastObject
+        # semantics, NFCSceneAOIModule.cpp:531-593).
         self._changed: Dict[Tuple[str, str], np.ndarray] = {}
         for cname in self.sync_classes:
             spec = self.kernel.store.spec(cname)
             for slot in spec.slots.values():
-                if slot.prop.public:
+                if slot.prop.public or slot.prop.private:
                     self.kernel.register_property_event(
                         cname, slot.prop.name, self._queue_change
+                    )
+        # record sync: host per-op hooks + device record diffs feed one
+        # accumulator, flushed per frame (the round-1 gap: bag/equip/buff
+        # changes mid-session never reached clients;
+        # reference NFCGameServerNet_ServerModule.cpp:75-81)
+        # (cname, rname) -> {"add": set, "del": set, "upd": dict, "swap": list}
+        self._rec_changed: Dict[Tuple[str, str], Dict[str, object]] = {}
+        self.kernel.subscribe_record_host(self._on_record_host)
+        self._synced_records: Dict[Tuple[str, str], bool] = {}  # -> public?
+        for cname in self.sync_classes:
+            spec = self.kernel.store.spec(cname)
+            for rname, rs in spec.records.items():
+                d = rs.rec
+                if d.public or d.private or d.upload:
+                    self._synced_records[(cname, rname)] = bool(d.public)
+                    self.kernel.register_record_diff(
+                        cname, rname, self._on_record_diff
                     )
 
     def _install(self) -> None:
@@ -318,6 +377,13 @@ class GameRole(ServerRole):
         )
         self._send_to_session(sess, MsgID.ACK_ENTER_GAME, ack)
         self._send_snapshots(sess)
+        if self.cross_server_sync:
+            from ..wire import RoleOnlineNotify
+
+            self.world_link.send_to_all(
+                int(MsgID.ACK_ONLINE_NOTIFY),
+                wrap(RoleOnlineNotify(), player_id=guid_ident(guid)),
+            )
 
     def _on_leave_game(self, conn_id: int, _msg_id: int, body: bytes) -> None:
         base, _ = unwrap(body)
@@ -337,6 +403,13 @@ class GameRole(ServerRole):
             self.kernel.destroy_object(guid)
         leave = AckPlayerLeaveList(object_list=[guid_ident(guid)])
         self._broadcast(targets, MsgID.ACK_OBJECT_LEAVE, leave, exclude=guid)
+        if self.cross_server_sync:
+            from ..wire import RoleOfflineNotify
+
+            self.world_link.send_to_all(
+                int(MsgID.ACK_OFFLINE_NOTIFY),
+                wrap(RoleOfflineNotify(), player_id=guid_ident(guid)),
+            )
 
     def _on_socket(self, conn_id: int, kind: int) -> None:
         if kind != EV_DISCONNECTED:
@@ -483,11 +556,12 @@ class GameRole(ServerRole):
             self.kernel.execute()
             self.kernel.tick()
             pm.frame += 1
-        if self._changed:
+        if self._changed or self._rec_changed:
             if self.sessions:
                 self._flush_changes()
             else:
                 self._changed.clear()
+                self._rec_changed.clear()
         # periodic autosave: device-side deaths free the row before any
         # BEFORE_DESTROY hook can run, so the blob must already be fresh
         if (self.data_agent is not None
@@ -506,9 +580,268 @@ class GameRole(ServerRole):
             rows.copy() if prev is None else np.union1d(prev, rows)
         )
 
+    # ---------------------------------------------------- record accumulation
+    def _rec_bucket(self, cname: str, rname: str) -> Dict[str, object]:
+        b = self._rec_changed.get((cname, rname))
+        if b is None:
+            # resync: rows whose FINAL state should be re-sent wholesale
+            # (used -> add-row, unused -> remove).  Swaps land here: a
+            # fixed replay order can't preserve intra-frame interleaving
+            # of swap with other ops, but final-state resync always can.
+            b = {"add": set(), "del": set(), "upd": {}, "resync": set()}
+            self._rec_changed[(cname, rname)] = b
+        return b
+
+    def _on_record_host(self, cname, rname, op, erows, rec_row, tags) -> None:
+        """Host-path per-op record hook (store mutators)."""
+        if (cname, rname) not in self._synced_records:
+            return
+        b = self._rec_bucket(cname, rname)
+        if op == RecordOp.ADD:
+            for e in erows:
+                key = (int(e), int(rec_row))
+                if key not in b["resync"]:
+                    b["add"].add(key)
+        elif op == RecordOp.DEL:
+            for e in erows:
+                key = (int(e), int(rec_row))
+                b["del"].add(key)
+                b["add"].discard(key)
+                b["upd"].pop(key, None)
+                b["resync"].discard(key)
+        elif op == RecordOp.UPDATE:
+            for e in erows:
+                key = (int(e), int(rec_row))
+                if key in b["add"] or key in b["resync"]:
+                    continue  # full-row send already pending
+                cur = b["upd"].get(key, set())
+                if cur is None or tags is None:
+                    b["upd"][key] = None  # None = resend every column
+                else:
+                    b["upd"][key] = cur | set(tags)
+        elif op == RecordOp.SWAP:
+            origin, target = rec_row
+            for e in erows:
+                for r in (int(origin), int(target)):
+                    key = (int(e), r)
+                    b["resync"].add(key)
+                    b["add"].discard(key)
+                    b["upd"].pop(key, None)
+                    b["del"].discard(key)
+
+    def _on_record_diff(self, cname: str, rname: str, codes: np.ndarray) -> None:
+        """Device-path record diff sink (buff expiry, stat groups, any
+        jitted phase that rewrites record arrays)."""
+        b = self._rec_bucket(cname, rname)
+        ent, rr = np.nonzero(codes)
+        for e, r, c in zip(ent.tolist(), rr.tolist(), codes[ent, rr].tolist()):
+            key = (e, r)
+            if c == REC_ADDED:
+                if key not in b["resync"]:
+                    b["add"].add(key)
+            elif c == REC_REMOVED:
+                b["del"].add(key)
+                b["add"].discard(key)
+                b["upd"].pop(key, None)
+                b["resync"].discard(key)
+            elif c == REC_UPDATED and key not in b["add"] and key not in b["resync"]:
+                b["upd"][key] = None
+
+    # ---------------------------------------------------- record serialization
+    def _obj_ident(self, raw: int) -> Ident:
+        g = self.kernel.store.guid_of_handle(int(raw))
+        return guid_ident(g) if g is not None else Ident()
+
+    def _record_cells(self, rs, r_i32, r_f32, r_vec, ent: int, r: int, tags):
+        """Per-kind cell messages for one record row, via the ONE shared
+        record→wire mapping (persist.codec.record_row_cells) so snapshots
+        and per-change sync can never diverge."""
+        from ...persist.codec import record_row_cells
+
+        return record_row_cells(
+            self.kernel.store, rs,
+            r_i32[ent] if r_i32 is not None else None,
+            r_f32[ent] if r_f32 is not None else None,
+            r_vec[ent] if r_vec is not None else None,
+            r, tags,
+        )
+
+    def _flush_records(self, player_idx=None) -> None:
+        """Mid-session record sync: accumulated per-op + device-diff record
+        changes → ACK_ADD_ROW / ACK_REMOVE_ROW / ACK_RECORD_* messages
+        (reference OnRecordEvent, NFCGameServerNet_ServerModule.cpp:75-81)."""
+        rec_changed, self._rec_changed = self._rec_changed, {}
+        k = self.kernel
+        if player_idx is None and rec_changed:
+            player_idx = self._build_player_index()
+        for (cname, rname), b in rec_changed.items():
+            public = self._synced_records.get((cname, rname), False)
+            spec = k.store.spec(cname)
+            rs = spec.records[rname]
+            rstate = k.state.classes[cname].records[rname]
+            used = np.asarray(rstate.used)
+            r_i32 = np.asarray(rstate.i32) if rs.n_i32 else None
+            r_f32 = np.asarray(rstate.f32) if rs.n_f32 else None
+            r_vec = np.asarray(rstate.vec) if rs.n_vec else None
+            host = k.store._hosts[cname]
+            rname_b = rname.encode()
+            per_entity: Dict[int, Dict[str, object]] = {}
+
+            def ops_of(e: int) -> Dict[str, object]:
+                o = per_entity.get(e)
+                if o is None:
+                    o = {"del": [], "add": [], "upd": {}}
+                    per_entity[e] = o
+                return o
+
+            for e, r in b["del"]:
+                ops_of(e)["del"].append(r)
+            for e, r in b["add"]:
+                ops_of(e)["add"].append(r)
+            # resync rows (swaps): final state decides add-row vs remove
+            for e, r in b["resync"]:
+                if used[e, r]:
+                    ops_of(e)["add"].append(r)
+                else:
+                    ops_of(e)["del"].append(r)
+            for (e, r), tags in b["upd"].items():
+                ops_of(e)["upd"][r] = tags
+
+            ent_rows = np.asarray(sorted(per_entity), np.int64)
+            ent_cells = (
+                self._rows_cells(cname, ent_rows)
+                if ent_rows.size else np.zeros((0, 2), np.int64)
+            )
+            cell_of = {
+                int(r): ent_cells[i].tolist() for i, r in enumerate(ent_rows)
+            }
+            for e, ops in per_entity.items():
+                guid = host.row_guid[e] if e < len(host.row_guid) else None
+                if guid is None:
+                    continue  # died since the change was queued
+                sc, gr = cell_of[e]
+                targets = self._targets_from_index(
+                    player_idx, guid, sc, gr, public, cname
+                )
+                if not targets:
+                    continue
+                pid = guid_ident(guid)
+                forward = public and cname == "Player"
+
+                def emit(msg_id, msg):
+                    self._broadcast(targets, msg_id, msg)
+                    if forward:
+                        self._forward_world(msg_id, msg, pid)
+
+                if ops["del"]:
+                    emit(MsgID.ACK_REMOVE_ROW,
+                         ObjectRecordRemove(
+                             player_id=pid, record_name=rname_b,
+                             remove_row=sorted(set(ops["del"]))))
+                add_rows = []
+                for r in sorted(set(ops["add"])):
+                    if not used[e, r]:
+                        continue  # added then removed within the frame
+                    add_rows.append(record_row_struct(
+                        k.store, rs,
+                        r_i32[e] if r_i32 is not None else None,
+                        r_f32[e] if r_f32 is not None else None,
+                        r_vec[e] if r_vec is not None else None,
+                        r))
+                if add_rows:
+                    emit(MsgID.ACK_ADD_ROW,
+                         ObjectRecordAddRow(
+                             player_id=pid, record_name=rname_b,
+                             row_data=add_rows))
+                u_ints: List[RecordInt] = []
+                u_floats: List[RecordFloat] = []
+                u_strings: List[RecordString] = []
+                u_objects: List[RecordObject] = []
+                u_vecs: List[RecordVector3] = []
+                for r, tags in sorted(ops["upd"].items()):
+                    if not used[e, r]:
+                        continue
+                    ints, floats, strings, objects, vecs = self._record_cells(
+                        rs, r_i32, r_f32, r_vec, e, r, tags)
+                    u_ints += ints
+                    u_floats += floats
+                    u_strings += strings
+                    u_objects += objects
+                    u_vecs += vecs
+                if u_ints:
+                    emit(MsgID.ACK_RECORD_INT,
+                         ObjectRecordInt(player_id=pid, record_name=rname_b,
+                                         property_list=u_ints))
+                if u_floats:
+                    emit(MsgID.ACK_RECORD_FLOAT,
+                         ObjectRecordFloat(player_id=pid, record_name=rname_b,
+                                           property_list=u_floats))
+                if u_strings:
+                    emit(MsgID.ACK_RECORD_STRING,
+                         ObjectRecordString(player_id=pid, record_name=rname_b,
+                                            property_list=u_strings))
+                if u_objects:
+                    emit(MsgID.ACK_RECORD_OBJECT,
+                         ObjectRecordObject(player_id=pid, record_name=rname_b,
+                                            property_list=u_objects))
+                if u_vecs:
+                    emit(MsgID.ACK_RECORD_VECTOR3,
+                         ObjectRecordVector3(player_id=pid, record_name=rname_b,
+                                             property_list=u_vecs))
+
+    # ------------------------------------------- frame-batched target index
+    def _build_player_index(self, player_class: str = "Player"):
+        """One-frame broadcast index: players by (scene, group) and by
+        scene — built with ONE device fetch per frame, replacing the
+        per-entity broadcast_targets calls (each of which fetched whole
+        columns; round-1: O(N) host cost at scale)."""
+        k = self.kernel
+        by_cell: Dict[Tuple[int, int], List[Guid]] = {}
+        by_scene: Dict[int, List[Guid]] = {}
+        spec = k.store.spec(player_class)
+        cs = k.state.classes[player_class]
+        host = k.store._hosts[player_class]
+        rows = np.flatnonzero(host.alloc_mask)
+        if rows.size:
+            cols = np.asarray(
+                cs.i32[rows][:, [spec.slots["SceneID"].col,
+                                 spec.slots["GroupID"].col]]
+            )
+            for r, (sc, gr) in zip(rows.tolist(), cols.tolist()):
+                g = host.row_guid[r]
+                if g is None:
+                    continue
+                by_cell.setdefault((sc, gr), []).append(g)
+                by_scene.setdefault(sc, []).append(g)
+        return by_cell, by_scene
+
+    def _targets_from_index(self, idx, guid: Guid, sc: int, gr: int,
+                            public: bool, cname: str) -> List[Guid]:
+        """GetBroadCastObject over the frame index: Public → players in the
+        same (scene, group), GroupID 0 → scene-wide; Private → self if a
+        player (NFCSceneAOIModule.cpp:531-593)."""
+        if not public:
+            return [guid] if cname == "Player" else []
+        by_cell, by_scene = idx
+        if gr == 0:
+            return by_scene.get(sc, [])
+        return by_cell.get((sc, gr), [])
+
+    def _rows_cells(self, cname: str, rows: np.ndarray) -> np.ndarray:
+        """[n, 2] (SceneID, GroupID) for the given rows — one device
+        gather instead of two get_property round trips per entity."""
+        k = self.kernel
+        spec = k.store.spec(cname)
+        cs = k.state.classes[cname]
+        return np.asarray(
+            cs.i32[rows][:, [spec.slots["SceneID"].col,
+                             spec.slots["GroupID"].col]]
+        )
+
     def _flush_changes(self) -> None:
         """The batched §3.3 spine: changed cells → grouped property-sync
-        messages → proxy (client lists in the envelope)."""
+        messages → proxy (client lists in the envelope).  All device reads
+        are row-subset gathers done once per class per frame."""
         k = self.kernel
         changed, self._changed = self._changed, {}
         # regroup per (class, row) so each entity sends one message per kind
@@ -516,15 +849,28 @@ class GameRole(ServerRole):
         for (cname, pname), rows in changed.items():
             for row in rows:
                 per_entity.setdefault((cname, int(row)), []).append(pname)
-        bank_cache: Dict[Tuple[str, str], np.ndarray] = {}
+        player_idx = self._build_player_index()
+        rows_by_class: Dict[str, np.ndarray] = {}
+        for cname, row in per_entity:
+            rows_by_class.setdefault(cname, []).append(row)
+        pos_by_class: Dict[str, Dict[int, int]] = {}
+        cells_by_class: Dict[str, np.ndarray] = {}
+        for cname, rws in list(rows_by_class.items()):
+            arr = np.asarray(sorted(set(rws)), np.int64)
+            rows_by_class[cname] = arr
+            pos_by_class[cname] = {int(r): i for i, r in enumerate(arr)}
+            cells_by_class[cname] = self._rows_cells(cname, arr)
+        sub_cache: Dict[Tuple[str, str], np.ndarray] = {}
 
         def bank_vals(cname: str, bank: Bank) -> np.ndarray:
+            """Row-subset bank fetch, indexed by LOCAL position."""
             key = (cname, bank.value)
-            if key not in bank_cache:
-                bank_cache[key] = np.asarray(
-                    getattr(k.state.classes[cname], bank.value)
+            if key not in sub_cache:
+                cs = k.state.classes[cname]
+                sub_cache[key] = np.asarray(
+                    getattr(cs, bank.value)[rows_by_class[cname]]
                 )
-            return bank_cache[key]
+            return sub_cache[key]
 
         for (cname, row), pnames in per_entity.items():
             host = k.store._hosts[cname]
@@ -532,47 +878,117 @@ class GameRole(ServerRole):
             if guid is None:
                 continue  # died since the change was queued
             spec = k.store.spec(cname)
-            ints: List[PropertyInt] = []
-            floats: List[PropertyFloat] = []
-            strings: List[PropertyString] = []
-            vecs: List[PropertyVector3] = []
-            for pname in pnames:
-                slot = spec.slot(pname)
-                raw = bank_vals(cname, slot.bank)[row, slot.col]
-                p = slot.prop
-                if p.type == DataType.INT:
-                    ints.append(PropertyInt(
-                        property_name=p.name.encode(), data=int(raw)))
-                elif p.type == DataType.FLOAT:
-                    floats.append(PropertyFloat(
-                        property_name=p.name.encode(), data=float(raw)))
-                elif p.type == DataType.STRING:
-                    strings.append(PropertyString(
-                        property_name=p.name.encode(),
-                        data=k.store.strings.lookup(int(raw)).encode()))
-                else:
-                    vecs.append(PropertyVector3(
-                        property_name=p.name.encode(),
-                        data=Vector3(x=float(raw[0]), y=float(raw[1]),
-                                     z=float(raw[2]))))
-            targets = self._scene_players(guid)
-            pid = guid_ident(guid)
-            if ints:
-                self._broadcast(targets, MsgID.ACK_PROPERTY_INT,
-                                ObjectPropertyInt(player_id=pid,
-                                                  property_list=ints))
-            if floats:
-                self._broadcast(targets, MsgID.ACK_PROPERTY_FLOAT,
-                                ObjectPropertyFloat(player_id=pid,
-                                                    property_list=floats))
-            if strings:
-                self._broadcast(targets, MsgID.ACK_PROPERTY_STRING,
-                                ObjectPropertyList(player_id=pid,
-                                                   property_string_list=strings))
-            if vecs:
-                self._broadcast(targets, MsgID.ACK_PROPERTY_VECTOR3,
-                                ObjectPropertyList(player_id=pid,
-                                                   property_vector3_list=vecs))
+            pos = pos_by_class[cname][row]
+            sc, gr = cells_by_class[cname][pos].tolist()
+            # public props broadcast to the (scene, group); private-only
+            # props go to the owner's client alone
+            for public in (True, False):
+                sel = [
+                    p for p in pnames
+                    if bool(spec.slot(p).prop.public) is public
+                    and (public or spec.slot(p).prop.private)
+                ]
+                if not sel:
+                    continue
+                targets = self._targets_from_index(
+                    player_idx, guid, sc, gr, public, cname
+                )
+                if not targets:
+                    continue
+                self._send_property_msgs(
+                    cname, pos, guid, sel, targets, bank_vals,
+                    forward=(public and cname == "Player"),
+                )
+        self._flush_records(player_idx)
+
+    def _forward_world(self, msg_id: int, msg: Message, pid: Ident) -> None:
+        """Push a sync message up the world link for cross-game relay."""
+        if self.cross_server_sync:
+            self.world_link.send_to_all(int(msg_id), wrap(msg, player_id=pid))
+
+    def _send_property_msgs(self, cname, row, guid, pnames, targets,
+                            bank_vals, forward: bool = False) -> None:
+        k = self.kernel
+        spec = k.store.spec(cname)
+        ints: List[PropertyInt] = []
+        floats: List[PropertyFloat] = []
+        strings: List[PropertyString] = []
+        objects: List[PropertyObject] = []
+        vec2s: List[PropertyVector2] = []
+        vec3s: List[PropertyVector3] = []
+        for pname in pnames:
+            slot = spec.slot(pname)
+            raw = bank_vals(cname, slot.bank)[row, slot.col]
+            p = slot.prop
+            if p.type == DataType.INT:
+                ints.append(PropertyInt(
+                    property_name=p.name.encode(), data=int(raw)))
+            elif p.type == DataType.FLOAT:
+                floats.append(PropertyFloat(
+                    property_name=p.name.encode(), data=float(raw)))
+            elif p.type == DataType.STRING:
+                strings.append(PropertyString(
+                    property_name=p.name.encode(),
+                    data=k.store.strings.lookup(int(raw)).encode()))
+            elif p.type == DataType.OBJECT:
+                objects.append(PropertyObject(
+                    property_name=p.name.encode(),
+                    data=self._obj_ident(int(raw))))
+            elif p.type == DataType.VECTOR2:
+                vec2s.append(PropertyVector2(
+                    property_name=p.name.encode(),
+                    data=Vector2(x=float(raw[0]), y=float(raw[1]))))
+            else:
+                vec3s.append(PropertyVector3(
+                    property_name=p.name.encode(),
+                    data=Vector3(x=float(raw[0]), y=float(raw[1]),
+                                 z=float(raw[2]))))
+        pid = guid_ident(guid)
+        # dedicated per-type messages matching the reference proto
+        # (ObjectProperty{Int,Float,String,Object,Vector2,Vector3} all carry
+        # player_id=1, property_list=2 — a protoc-generated client decodes
+        # these directly)
+        for msg_id, cls, items in (
+            (MsgID.ACK_PROPERTY_INT, ObjectPropertyInt, ints),
+            (MsgID.ACK_PROPERTY_FLOAT, ObjectPropertyFloat, floats),
+            (MsgID.ACK_PROPERTY_STRING, ObjectPropertyString, strings),
+            (MsgID.ACK_PROPERTY_OBJECT, ObjectPropertyObject, objects),
+            (MsgID.ACK_PROPERTY_VECTOR2, ObjectPropertyVector2, vec2s),
+            (MsgID.ACK_PROPERTY_VECTOR3, ObjectPropertyVector3, vec3s),
+        ):
+            if items:
+                msg = cls(player_id=pid, property_list=items)
+                self._broadcast(targets, msg_id, msg)
+                if forward:
+                    self._forward_world(msg_id, msg, pid)
+
+    # --------------------------------------------------- cross-game delivery
+    def _on_world_sync(self, _sid: int, msg_id: int, body: bytes) -> None:
+        """World-relayed sync from another game server: deliver to every
+        local client (world-scope visibility; the client mirror creates
+        remote objects lazily on first property message)."""
+        if not self.sessions:
+            return
+        base = MsgBase.decode(body)
+        src = self._guid_of_ident(base.player_id)
+        if src is not None and src in self.kernel.store.guid_map:
+            return  # the entity lives here — local broadcast already covered it
+        if msg_id == int(MsgID.ACK_ONLINE_NOTIFY):
+            return  # mirror objects appear lazily with the first sync message
+        per_conn: Dict[int, List[Ident]] = {}
+        for sess in self.sessions.values():
+            per_conn.setdefault(sess.conn_id, []).append(sess.ident)
+        if msg_id == int(MsgID.ACK_OFFLINE_NOTIFY):
+            leave = AckPlayerLeaveList(object_list=[base.player_id])
+            for conn_id, idents in per_conn.items():
+                self._send_to(idents, conn_id, MsgID.ACK_OBJECT_LEAVE, leave)
+            return
+        for conn_id, idents in per_conn.items():
+            self.server.send_raw(
+                conn_id, msg_id,
+                MsgBase(player_id=base.player_id, msg_data=base.msg_data,
+                        player_client_list=idents).encode(),
+            )
 
     # ------------------------------------------------------------ leave events
     def _on_class_event(self, guid: Guid, _cname: str, ev: ObjectEvent) -> None:

@@ -14,6 +14,8 @@ client).  The proxy learns the live game-server set from World
 
 from __future__ import annotations
 
+import hmac
+import time as _time
 from typing import Dict, Optional, Tuple
 
 from ..defines import EventCode, MsgID, ServerType
@@ -38,9 +40,13 @@ _IdentKey = Tuple[int, int]  # (svrid, index)
 class ProxyRole(ServerRole):
     server_type = int(ServerType.PROXY)
 
+    KEY_TTL_S = 120.0  # a grant the client never redeems expires
+
     def __init__(self, config: RoleConfig, backend: str = "auto") -> None:
-        # account -> world-minted connect key, pre-authorized by World
-        self._keys: Dict[str, str] = {}
+        # account -> (world-minted connect key, expiry monotonic time);
+        # one-time use, TTL-bounded — a captured account+key pair can't
+        # re-authenticate after the legitimate redeem
+        self._keys: Dict[str, Tuple[str, float]] = {}
         # verified client ident -> conn_id (the Transpond routing table)
         self._client_conn: Dict[_IdentKey, int] = {}
         # conn_id -> binding info, survives until the disconnect handler has
@@ -73,8 +79,12 @@ class ProxyRole(ServerRole):
     # ------------------------------------------------------ world side
     def _on_key_granted(self, _sid: int, _msg_id: int, body: bytes) -> None:
         _, grant = unwrap(body, AckConnectWorldResult)
-        self._keys[grant.account.decode("utf-8", "replace")] = grant.world_key.decode(
-            "utf-8", "replace"
+        now = _time.monotonic()
+        # sweep never-redeemed expired grants so the map stays bounded
+        self._keys = {a: kv for a, kv in self._keys.items() if kv[1] > now}
+        self._keys[grant.account.decode("utf-8", "replace")] = (
+            grant.world_key.decode("utf-8", "replace"),
+            now + self.KEY_TTL_S,
         )
 
     def _on_game_list(self, _sid: int, _msg_id: int, body: bytes) -> None:
@@ -104,8 +114,17 @@ class ProxyRole(ServerRole):
         _, req = unwrap(body, ReqAccountLogin)
         account = req.account.decode("utf-8", "replace")
         key = req.security_code.decode("utf-8", "replace")
-        ok = account and self._keys.get(account) == key
+        granted = self._keys.get(account)
+        if granted is not None and _time.monotonic() >= granted[1]:
+            del self._keys[account]  # expired, never redeemable
+            granted = None
+        ok = (
+            bool(account)
+            and granted is not None
+            and hmac.compare_digest(granted[0], key)
+        )
         if ok:
+            del self._keys[account]  # one-time use
             ident = Ident(svrid=self.config.server_id, index=conn_id)
             tags = self.server.conn_tags.setdefault(conn_id, {})
             tags["account"] = account

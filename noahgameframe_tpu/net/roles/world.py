@@ -22,13 +22,41 @@ from ..defines import MsgID, ServerType
 from ..transport import EV_DISCONNECTED
 from ..wire import (
     AckConnectWorldResult,
+    Ident,
+    MsgBase,
     ReqConnectWorld,
+    RoleOfflineNotify,
     ServerInfoReport,
     ServerInfoReportList,
+    ident_key as _ident_key,
     unwrap,
     wrap,
 )
 from .base import RoleConfig, ServerRole, decode_reports
+
+# game→world sync traffic the World relays to every OTHER game server so
+# players on different game servers converge on each other's public state
+# (reference NFCWorldNet_ServerModule.cpp:600-830 rebuilds and re-sends
+# property/record packs world-side; here the already-encoded game message
+# is transponded verbatim — the TPU game server already batched it)
+CROSS_SYNC_MSGS = (
+    MsgID.ACK_ONLINE_NOTIFY,
+    MsgID.ACK_OFFLINE_NOTIFY,
+    MsgID.ACK_PROPERTY_INT,
+    MsgID.ACK_PROPERTY_FLOAT,
+    MsgID.ACK_PROPERTY_STRING,
+    MsgID.ACK_PROPERTY_OBJECT,
+    MsgID.ACK_PROPERTY_VECTOR2,
+    MsgID.ACK_PROPERTY_VECTOR3,
+    MsgID.ACK_ADD_ROW,
+    MsgID.ACK_REMOVE_ROW,
+    MsgID.ACK_SWAP_ROW,
+    MsgID.ACK_RECORD_INT,
+    MsgID.ACK_RECORD_FLOAT,
+    MsgID.ACK_RECORD_STRING,
+    MsgID.ACK_RECORD_OBJECT,
+    MsgID.ACK_RECORD_VECTOR3,
+)
 
 
 @dataclasses.dataclass
@@ -44,6 +72,9 @@ class WorldRole(ServerRole):
     def __init__(self, config: RoleConfig, backend: str = "auto") -> None:
         self.games: Dict[int, _Downstream] = {}
         self.proxies: Dict[int, _Downstream] = {}
+        # world roster: online player ident -> owning game server id
+        # (fed by ACK_ONLINE/OFFLINE_NOTIFY; the reference's OnOnlineProcess)
+        self.roster: Dict[tuple, int] = {}
         super().__init__(config, backend=backend)
         self.master = self.add_upstream(
             "master",
@@ -62,7 +93,26 @@ class WorldRole(ServerRole):
             s.on(msg, self._on_proxy_register)
         s.on(MsgID.PTWG_PROXY_UNREGISTERED, self._on_proxy_unregister)
         s.on(MsgID.STS_SERVER_REPORT, self._on_server_report)
+        for msg in CROSS_SYNC_MSGS:
+            s.on(msg, self._on_cross_sync)
         s.on_socket_event(self._on_socket)
+
+    # ------------------------------------------- cross-game sync relay
+    def _on_cross_sync(self, conn_id: int, msg_id: int, body: bytes) -> None:
+        """Property/record sync relay game→world→other games
+        (NFCWorldNet_ServerModule.cpp:600-830).  The envelope is relayed
+        verbatim; the roster tracks online players per game."""
+        if msg_id in (int(MsgID.ACK_ONLINE_NOTIFY), int(MsgID.ACK_OFFLINE_NOTIFY)):
+            base = MsgBase.decode(body)
+            sid = self.server.conn_tags.get(conn_id, {}).get("server_id")
+            key = _ident_key(base.player_id)
+            if msg_id == int(MsgID.ACK_ONLINE_NOTIFY) and sid is not None:
+                self.roster[key] = sid
+            else:
+                self.roster.pop(key, None)
+        for d in self.games.values():
+            if d.conn_id != conn_id:
+                self.server.send_raw(d.conn_id, msg_id, body)
 
     # ---------------------------------------------------- registration
     def _on_game_register(self, conn_id: int, _msg_id: int, body: bytes) -> None:
@@ -119,9 +169,22 @@ class WorldRole(ServerRole):
             return
         # unplanned death: tell Master (CRASH state) and re-push the game
         # list so proxies stop routing to the corpse
+        dead_ids = set()
         for d in dead:
             d.report.server_state = int(ServerState.CRASH)
+            dead_ids.add(d.report.server_id)
             self._relay_report(d.report)
+        # synthesize offline notifies for the dead game's players so other
+        # games' clients drop their (now frozen) remote mirrors
+        orphans = [k for k, v in self.roster.items() if v in dead_ids]
+        for svrid, index in orphans:
+            del self.roster[(svrid, index)]
+            body = wrap(RoleOfflineNotify(),
+                        player_id=Ident(svrid=svrid, index=index))
+            for d in self.games.values():
+                self.server.send_raw(
+                    d.conn_id, int(MsgID.ACK_OFFLINE_NOTIFY), body
+                )
         self._push_game_list()
 
     # ---------------------------------------------- game list to proxies

@@ -308,6 +308,34 @@ class ObjectPropertyFloat(Message):
     ]
 
 
+class ObjectPropertyString(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "property_list", R(PropertyString), None),
+    ]
+
+
+class ObjectPropertyObject(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "property_list", R(PropertyObject), None),
+    ]
+
+
+class ObjectPropertyVector2(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "property_list", R(PropertyVector2), None),
+    ]
+
+
+class ObjectPropertyVector3(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "property_list", R(PropertyVector3), None),
+    ]
+
+
 class RecordInt(Message):
     FIELDS = [(1, "row", "int32", 0), (2, "col", "int32", 0), (3, "data", "int64", 0)]
 
@@ -324,6 +352,10 @@ class RecordObject(Message):
     FIELDS = [(1, "row", "int32", 0), (2, "col", "int32", 0), (3, "data", Ident, None)]
 
 
+class RecordVector2(Message):
+    FIELDS = [(1, "row", "int32", 0), (2, "col", "int32", 0), (3, "data", Vector2, None)]
+
+
 class RecordVector3(Message):
     FIELDS = [(1, "row", "int32", 0), (2, "col", "int32", 0), (3, "data", Vector3, None)]
 
@@ -335,6 +367,7 @@ class RecordAddRowStruct(Message):
         (3, "record_float_list", R(RecordFloat), None),
         (4, "record_string_list", R(RecordString), None),
         (5, "record_object_list", R(RecordObject), None),
+        (6, "record_vector2_list", R(RecordVector2), None),
         (7, "record_vector3_list", R(RecordVector3), None),
     ]
 
@@ -350,6 +383,84 @@ class ObjectRecordList(Message):
     FIELDS = [
         (1, "player_id", Ident, None),
         (2, "record_list", R(ObjectRecordBase), None),
+    ]
+
+
+# ---- per-change record sync (reference NFMsgBase.proto:183-251; the
+# messages NFCGameServerNet_ServerModule::OnRecordEvent emits per op) ----
+
+
+class ObjectRecordInt(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "record_name", "bytes", b""),
+        (3, "property_list", R(RecordInt), None),
+    ]
+
+
+class ObjectRecordFloat(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "record_name", "bytes", b""),
+        (3, "property_list", R(RecordFloat), None),
+    ]
+
+
+class ObjectRecordString(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "record_name", "bytes", b""),
+        (3, "property_list", R(RecordString), None),
+    ]
+
+
+class ObjectRecordObject(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "record_name", "bytes", b""),
+        (3, "property_list", R(RecordObject), None),
+    ]
+
+
+class ObjectRecordVector2(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "record_name", "bytes", b""),
+        (3, "property_list", R(RecordVector2), None),
+    ]
+
+
+class ObjectRecordVector3(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "record_name", "bytes", b""),
+        (3, "property_list", R(RecordVector3), None),
+    ]
+
+
+class ObjectRecordSwap(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "origin_record_name", "bytes", b""),
+        (3, "target_record_name", "bytes", None),
+        (4, "row_origin", "int32", 0),
+        (5, "row_target", "int32", 0),
+    ]
+
+
+class ObjectRecordAddRow(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "record_name", "bytes", b""),
+        (3, "row_data", R(RecordAddRowStruct), None),
+    ]
+
+
+class ObjectRecordRemove(Message):
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "record_name", "bytes", b""),
+        (3, "remove_row", R("int32"), None),
     ]
 
 
@@ -494,6 +605,17 @@ class ReqDeleteRole(Message):
 
 class ServerHeartBeat(Message):
     FIELDS = [(1, "count", "int32", 0)]
+
+
+class RoleOnlineNotify(Message):
+    """Game → World: a player came online (player guid rides the MsgBase
+    envelope; `NFMsgPreGame.proto` RoleOnlineNotify)."""
+
+    FIELDS = [(1, "guild", Ident, None)]
+
+
+class RoleOfflineNotify(Message):
+    FIELDS = [(1, "guild", Ident, None)]
 
 
 # =====================================================================

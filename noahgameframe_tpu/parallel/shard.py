@@ -103,6 +103,8 @@ class ShardedKernel:
             fired=raw["fired"],
             diff=raw["diff"],
             diff_count=raw["diff_count"],
+            rec_diff=raw["rec_diff"],
+            rec_diff_count=raw["rec_diff_count"],
             died=raw["died"],
             died_count=raw["died_count"],
             events=[

@@ -15,7 +15,7 @@ from typing import Callable, Dict, List, Optional
 from ..core.datatypes import Guid
 from ..kernel.kernel import Kernel, ObjectEvent
 from ..net.wire import AckRoleLiteInfoList, RoleLiteInfo
-from .codec import apply_snapshot, snapshot_object
+from .codec import apply_snapshot, resolve_pending, snapshot_object
 from .kv import KVStore
 
 KeyFn = Callable[[Guid], Optional[str]]
@@ -43,6 +43,10 @@ class PlayerDataAgent:
         self.flags = flags
         self.kernel: Optional[Kernel] = None
         self._key_fn = key_fn
+        # OBJECT refs whose targets weren't loaded yet (e.g. a player's
+        # GuildID applied before the guild entity exists); re-resolved on
+        # every subsequent load and via resolve_refs()
+        self._pending: list = []
 
     def bind(self, kernel: Kernel) -> "PlayerDataAgent":
         self.kernel = kernel
@@ -76,8 +80,18 @@ class PlayerDataAgent:
         if blob is None:
             return False
         k = self.kernel
-        k.state = apply_snapshot(k.store, k.state, guid, blob)
+        k.state = apply_snapshot(k.store, k.state, guid, blob, self._pending)
+        self.resolve_refs()
         return True
+
+    def resolve_refs(self) -> int:
+        """Re-apply deferred OBJECT references whose targets exist now;
+        returns how many remain unresolved (load-order independence)."""
+        if not self._pending:
+            return 0
+        k = self.kernel
+        k.state, self._pending = resolve_pending(k.store, k.state, self._pending)
+        return len(self._pending)
 
     def save(self, guid: Guid) -> bool:
         key = self._key_of(guid)

@@ -51,13 +51,24 @@ def _flatten_state(state: WorldState) -> Dict[str, np.ndarray]:
     return out
 
 
-def save_world(kernel: Kernel, path: Path) -> None:
-    """Snapshot the whole world (device state + host identity) to disk."""
+def save_world(kernel: Kernel, path: Path, modules=()) -> None:
+    """Snapshot the whole world (device state + host identity) to disk.
+
+    `modules` — iterable of Modules whose `checkpoint_state()` host state
+    (teams, guild name index, mailboxes, rank lists, buff defs…) must
+    survive the resume; without them a restored player's TeamID would
+    point at a Team entity the TeamModule no longer knows."""
     path = Path(path)
     path.mkdir(parents=True, exist_ok=True)
     np.savez_compressed(path / "arrays.npz", **_flatten_state(kernel.state))
     store = kernel.store
+    mod_states = {}
+    for m in modules:
+        data = m.checkpoint_state()
+        if data is not None:
+            mod_states[m.name] = data
     meta = {
+        "modules": mod_states,
         "class_order": store.class_order,
         "tick_count": kernel.tick_count,
         "strings": store.strings.snapshot(),
@@ -78,9 +89,12 @@ def save_world(kernel: Kernel, path: Path) -> None:
     (path / "meta.json").write_text(json.dumps(meta))
 
 
-def load_world(kernel: Kernel, path: Path) -> None:
+def load_world(kernel: Kernel, path: Path, modules=()) -> None:
     """Restore a checkpoint into a kernel built from the SAME schema and
-    capacities (shape mismatch raises)."""
+    capacities (shape mismatch raises).  Pass the same `modules` given to
+    save_world; their host state restores after identity maps (so guids
+    resolve).  Module state present in the checkpoint but not claimed by
+    any passed module is ignored."""
     path = Path(path)
     arrays = np.load(path / "arrays.npz")
     meta = json.loads((path / "meta.json").read_text())
@@ -150,3 +164,13 @@ def load_world(kernel: Kernel, path: Path) -> None:
             Guid.parse(s) if s else None for s in hmeta["row_guid"]
         ]
         host.live_count = int(hmeta["live_count"])
+        # alloc_mask is derived state — rebuild from row_guid, else
+        # reconcile_deaths/_build_player_index see the pre-load allocation
+        host.alloc_mask = np.asarray(
+            [g is not None for g in host.row_guid], bool
+        )
+    mod_states = meta.get("modules", {})
+    for m in modules:
+        data = mod_states.get(m.name)
+        if data is not None:
+            m.restore_state(data)

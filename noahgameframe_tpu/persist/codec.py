@@ -55,6 +55,51 @@ def _guid_to_ident(store: EntityStore, handle: int) -> Ident:
     return Ident(svrid=g.head if g else 0, index=g.data if g else 0)
 
 
+def record_row_cells(store, rs, i32_rows, f32_rows, vec_rows, r_i, tags=None):
+    """Per-kind wire cell lists for one record row — the ONE record→wire
+    cell mapping (snapshots and per-change sync must emit identical
+    encodings).  `i32_rows`/`f32_rows`/`vec_rows` are one entity's record
+    arrays [R, ncols]; `col` on the wire is the position in col_order;
+    `tags` restricts to a column subset (None = all)."""
+    ints, floats, strings, objects, vecs = [], [], [], [], []
+    for c_i, tag in enumerate(rs.col_order):
+        if tags is not None and tag not in tags:
+            continue
+        cslot = rs.cols[tag]
+        t = cslot.col_def.type
+        if cslot.bank == Bank.I32:
+            raw = int(i32_rows[r_i, cslot.col])
+            if t == DataType.STRING:
+                strings.append(RecordString(
+                    row=r_i, col=c_i,
+                    data=store.strings.lookup(raw).encode()))
+            elif t == DataType.OBJECT:
+                objects.append(RecordObject(
+                    row=r_i, col=c_i, data=_guid_to_ident(store, raw)))
+            else:
+                ints.append(RecordInt(row=r_i, col=c_i, data=raw))
+        elif cslot.bank == Bank.F32:
+            floats.append(RecordFloat(
+                row=r_i, col=c_i, data=float(f32_rows[r_i, cslot.col])))
+        else:
+            v = vec_rows[r_i, cslot.col]
+            vecs.append(RecordVector3(
+                row=r_i, col=c_i,
+                data=Vector3(x=float(v[0]), y=float(v[1]), z=float(v[2]))))
+    return ints, floats, strings, objects, vecs
+
+
+def record_row_struct(store, rs, i32_rows, f32_rows, vec_rows, r_i,
+                      tags=None) -> RecordAddRowStruct:
+    """One full record row as a wire RecordAddRowStruct."""
+    ints, floats, strings, objects, vecs = record_row_cells(
+        store, rs, i32_rows, f32_rows, vec_rows, r_i, tags)
+    return RecordAddRowStruct(
+        row=r_i, record_int_list=ints, record_float_list=floats,
+        record_string_list=strings, record_object_list=objects,
+        record_vector3_list=vecs)
+
+
 def serialize_properties(
     store: EntityStore,
     state: WorldState,
@@ -123,34 +168,9 @@ def serialize_records(
         r_vec = np.asarray(rstate.vec[row]) if rs.n_vec else None
         base = ObjectRecordBase(record_name=rname.encode())
         for r_i in np.flatnonzero(used):
-            rowmsg = RecordAddRowStruct(row=int(r_i))
-            for c_i, tag in enumerate(rs.col_order):
-                cslot = rs.cols[tag]
-                t = cslot.col_def.type
-                if cslot.bank == Bank.I32:
-                    raw = int(r_i32[int(r_i), cslot.col])
-                    if t == DataType.STRING:
-                        rowmsg.record_string_list.append(RecordString(
-                            row=int(r_i), col=c_i,
-                            data=store.strings.lookup(raw).encode()))
-                    elif t == DataType.OBJECT:
-                        rowmsg.record_object_list.append(RecordObject(
-                            row=int(r_i), col=c_i,
-                            data=_guid_to_ident(store, raw)))
-                    else:
-                        rowmsg.record_int_list.append(RecordInt(
-                            row=int(r_i), col=c_i, data=raw))
-                elif cslot.bank == Bank.F32:
-                    rowmsg.record_float_list.append(RecordFloat(
-                        row=int(r_i), col=c_i,
-                        data=float(r_f32[int(r_i), cslot.col])))
-                else:
-                    v = r_vec[int(r_i), cslot.col]
-                    rowmsg.record_vector3_list.append(RecordVector3(
-                        row=int(r_i), col=c_i,
-                        data=Vector3(x=float(v[0]), y=float(v[1]),
-                                     z=float(v[2]))))
-            base.row_struct.append(rowmsg)
+            base.row_struct.append(
+                record_row_struct(store, rs, r_i32, r_f32, r_vec, int(r_i))
+            )
         out.record_list.append(base)
     return out
 
@@ -189,7 +209,33 @@ def _ident_to_guid(store: EntityStore, ident: Optional[Ident]) -> Optional[Guid]
     g = Guid(ident.svrid, ident.index)
     if g.is_null() or g in store.guid_map:
         return g
-    return None  # referenced entity no longer exists
+    return None  # referenced entity doesn't exist (yet)
+
+
+# one unresolved OBJECT reference: owner guid, site, target guid.  site is
+# ("prop", name) or ("rec", record_name, row, tag)
+PendingRef = Tuple[Guid, Tuple, Guid]
+
+
+def resolve_pending(
+    store: EntityStore, state: WorldState, pending: List[PendingRef]
+) -> Tuple[WorldState, List[PendingRef]]:
+    """Re-apply deferred OBJECT references whose targets now exist (call
+    after a bulk load so restores aren't load-order dependent).  Returns
+    (state', still-unresolved)."""
+    left: List[PendingRef] = []
+    for owner, site, target in pending:
+        if owner not in store.guid_map:
+            continue  # owner died before the target appeared
+        if target not in store.guid_map:
+            left.append((owner, site, target))
+            continue
+        if site[0] == "prop":
+            state = store.set_property(state, owner, site[1], target)
+        else:
+            _, rname, row, tag = site
+            state = store.record_set(state, owner, rname, row, tag, target)
+    return state, left
 
 
 def apply_snapshot(
@@ -197,9 +243,14 @@ def apply_snapshot(
     state: WorldState,
     guid: Guid,
     blob: bytes,
+    pending: Optional[List[PendingRef]] = None,
 ) -> WorldState:
     """Write a saved blob back onto a live entity (load-on-create,
-    the COE_CREATE_LOADDATA attach)."""
+    the COE_CREATE_LOADDATA attach).
+
+    OBJECT references to not-yet-loaded entities are appended to `pending`
+    (resolve with resolve_pending after the batch) instead of being
+    silently dropped; with pending=None they are dropped as before."""
     pack = ObjectDataPack.decode(blob)
     cname, _ = store.row_of(guid)
     spec = store.spec(cname)
@@ -222,6 +273,10 @@ def apply_snapshot(
             target = _ident_to_guid(store, p.data)
             if target is not None:
                 state = store.set_property(state, guid, name, target)
+            elif pending is not None and p.data is not None:
+                pending.append(
+                    (guid, ("prop", name), Guid(p.data.svrid, p.data.index))
+                )
     for p in pl.property_vector3_list:
         name = p.property_name.decode()
         if not spec.has_property(name):
@@ -261,6 +316,13 @@ def apply_snapshot(
                     target = _ident_to_guid(store, c.data)
                     if target is not None:
                         values[tag] = target
+                    elif (pending is not None and c.data is not None
+                          and int(rowmsg.row) < rs.max_rows):
+                        pending.append((
+                            guid,
+                            ("rec", rname, int(rowmsg.row), tag),
+                            Guid(c.data.svrid, c.data.index),
+                        ))
             for c in rowmsg.record_vector3_list:
                 tag = tag_of(c.col)
                 if tag is None:

@@ -1,0 +1,85 @@
+"""Cross-game-server sync through the World relay: a public change on a
+player bound to game A reaches a client bound to game B
+(reference NFCWorldNet_ServerModule.cpp:600-830)."""
+
+from __future__ import annotations
+
+import pytest
+
+from noahgameframe_tpu.client import GameClient
+from noahgameframe_tpu.net.roles import LocalCluster
+
+
+@pytest.fixture(scope="module")
+def cluster2():
+    c = LocalCluster(http_port=0, n_games=2)
+    c.start(timeout=25.0)
+    yield c
+    c.shut()
+
+
+def drive(cluster, client, cond, timeout=12.0):
+    ok = cluster.pump_until(cond, extra=client.execute, timeout=timeout)
+    assert ok, f"timeout waiting for {cond}"
+
+
+def login_to_game(cluster, account: str, name: str, game_id: int) -> GameClient:
+    c = GameClient(account)
+    c.connect("127.0.0.1", cluster.login.config.port)
+    drive(cluster, c, lambda: c.connected)
+    c.login()
+    drive(cluster, c, lambda: c.logged_in)
+    c.request_world_list()
+    drive(cluster, c, lambda: c.worlds)
+    c.connect_world(c.worlds[0].server_id)
+    drive(cluster, c, lambda: c.world_grant is not None)
+    c.connect_proxy()
+    drive(cluster, c, lambda: c.connected)
+    c.verify_key()
+    drive(cluster, c, lambda: c.key_verified)
+    c.select_server(game_id)
+    drive(cluster, c, lambda: c.server_selected)
+    c.create_role(name)
+    drive(cluster, c, lambda: c.roles)
+    c.enter_game(name)
+    drive(cluster, c, lambda: c.entered)
+    return c
+
+
+def test_change_on_game_a_reaches_client_on_game_b(cluster2):
+    game_a, game_b = cluster2.games[0], cluster2.games[1]
+    a = login_to_game(cluster2, "ana", "Ana", game_a.config.server_id)
+    b = login_to_game(cluster2, "ben", "Ben", game_b.config.server_id)
+    # the avatars live on different game servers
+    assert any(s.account == "ana" and s.guid for s in game_a.sessions.values())
+    assert any(s.account == "ben" and s.guid for s in game_b.sessions.values())
+    # world roster saw both come online on their respective games
+    assert len(cluster2.world.roster) >= 2
+
+    class _Both:
+        def execute(self):
+            a.execute()
+            b.execute()
+
+    both = _Both()
+    akey = (a.player_guid.svrid, a.player_guid.index)
+    # a public property change on A (Level) relays world-side into B's mirror
+    from noahgameframe_tpu.core.datatypes import Guid
+
+    ga = Guid(a.player_guid.svrid, a.player_guid.index)
+    game_a.kernel.set_property(ga, "Level", 9)
+    drive(
+        cluster2, both,
+        lambda: b.objects.get(akey) is not None
+        and b.objects[akey].properties.get("Level") == 9,
+    )
+    # Ana's own mirror converges too (local path unaffected by the relay)
+    drive(
+        cluster2, both,
+        lambda: a.objects.get(akey) is not None
+        and a.objects[akey].properties.get("Level") == 9,
+    )
+    # offline: A leaves -> B's mirror drops the remote object
+    a.close()
+    drive(cluster2, both, lambda: akey not in b.objects, timeout=15.0)
+    b.close()

@@ -199,6 +199,66 @@ def test_world_checkpoint_resume(tmp_path):
     assert g2 in k2.store.guid_map
 
 
+def test_checkpoint_restores_module_host_state(tmp_path):
+    """Teams/guilds/mail/ranks live in module host maps; a resume without
+    them leaves restored TeamID properties dangling (round-1 advisor
+    finding) — GameWorld.save/load must round-trip them."""
+    w = make_world()
+    k = w.kernel
+    a = k.create_object("Player", {"Name": "A", "Account": "a"}, scene=1)
+    b = k.create_object("Player", {"Name": "B", "Account": "b"}, scene=1)
+    team_id = w.team.create_team(a)
+    assert w.team.join(team_id, b)
+    gid = w.guilds.create_guild(a, "Knights")
+    w.mail.send("b", "A", "hi", gold=10)
+    w.rank.update("power", "A", 99)
+    w.save(tmp_path / "ck")
+
+    w2 = make_world()
+    w2.load(tmp_path / "ck")
+    t = w2.team.team_of(b)
+    assert t is not None and t.team_id == team_id and t.leader == a
+    # leaving now works (round-1: silently no-opped) and updates the count
+    assert w2.team.leave(b)
+    assert int(w2.kernel.get_property(team_id, "MemberCount")) == 1
+    g2 = w2.guilds.find_by_name("Knights")
+    assert g2 is not None and g2.guild_id == gid
+    box = w2.mail.mailbox("b")
+    assert len(box) == 1 and box[0].gold == 10
+    assert w2.rank.top("power") == [("A", 99)]
+
+
+def test_pending_object_refs_resolve_after_load():
+    """A blob applied before its referenced entity exists must regain the
+    reference once the target loads (load-order independence)."""
+    from noahgameframe_tpu.persist.codec import (
+        apply_snapshot,
+        resolve_pending,
+        snapshot_object,
+    )
+
+    w = make_world()
+    k = w.kernel
+    a = k.create_object("Player", {"Name": "A", "Account": "a"}, scene=1)
+    gid = w.guilds.create_guild(a, "Order")
+    blob = snapshot_object(k.store, k.state, a, ("save",))
+    guild_blob = snapshot_object(k.store, k.state, gid, ("save",))
+
+    w2 = make_world()
+    k2 = w2.kernel
+    a2 = k2.create_object("Player", {"Name": "A", "Account": "a"}, scene=1,
+                          guid=a)
+    pending = []
+    k2.state = apply_snapshot(k2.store, k2.state, a2, blob, pending)
+    assert pending, "GuildID target not loaded yet -> must be deferred"
+    # now the guild entity arrives; the deferred ref resolves
+    g2 = k2.create_object("Guild", guid=gid)
+    k2.state = apply_snapshot(k2.store, k2.state, g2, guild_blob, pending)
+    k2.state, left = resolve_pending(k2.store, k2.state, pending)
+    assert not left
+    assert k2.get_property(a2, "GuildID") == gid
+
+
 def test_checkpoint_shape_mismatch_rejected(tmp_path):
     w = make_world()
     save_world(w.kernel, tmp_path / "ck")

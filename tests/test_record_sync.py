@@ -1,0 +1,204 @@
+"""Record change propagation: host per-op hooks (reference
+NFIRecord::AddRecordHook, NFCRecord.h:17-156), the device record diff in
+the jitted tick, swap-row, and the game-role -> client sync spine."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from noahgameframe_tpu.core.store import RecordOp, with_class
+from noahgameframe_tpu.kernel.kernel import (
+    Kernel,
+    REC_ADDED,
+    REC_REMOVED,
+    REC_UPDATED,
+)
+from noahgameframe_tpu.core.store import StoreConfig
+from noahgameframe_tpu.kernel.module import Module, Phase
+
+from fixtures import base_registry, make_store
+
+
+@pytest.fixture()
+def store():
+    return make_store()
+
+
+def _spawn_player(store):
+    state = store.init_state()
+    state, g, _row = store.create_object(state, "Player", values={"Name": "p"})
+    return state, g
+
+
+# ---------------------------------------------------------------- host hooks
+
+
+def test_host_hooks_fire_per_op(store):
+    state, g = _spawn_player(store)
+    events = []
+    store.subscribe_records(
+        lambda c, r, op, rows, rr, tags: events.append(
+            (c, r, op, rows.tolist(), rr, tags)
+        )
+    )
+    state, row0 = store.record_add_row(
+        state, g, "BagItems", {"ItemConfig": "potion", "Count": 3}
+    )
+    state = store.record_set(state, g, "BagItems", row0, "Count", 5)
+    state, row1 = store.record_add_row(
+        state, g, "BagItems", {"ItemConfig": "sword", "Count": 1}
+    )
+    state = store.record_swap_rows(state, g, "BagItems", row0, row1)
+    state = store.record_remove_row(state, g, "BagItems", row0)
+
+    ops = [(e[2], e[4]) for e in events]
+    assert ops == [
+        (RecordOp.ADD, row0),
+        (RecordOp.UPDATE, row0),
+        (RecordOp.ADD, row1),
+        (RecordOp.SWAP, (row0, row1)),
+        (RecordOp.DEL, row0),
+    ]
+    assert events[1][5] == ("Count",)  # UPDATE carries the touched tags
+    _, prow = store.row_of(g)
+
+
+def test_swap_rows_exchanges_contents(store):
+    state, g = _spawn_player(store)
+    state, r0 = store.record_add_row(
+        state, g, "BagItems", {"ItemConfig": "potion", "Count": 3}
+    )
+    state, r1 = store.record_add_row(
+        state, g, "BagItems", {"ItemConfig": "sword", "Count": 1}
+    )
+    state = store.record_swap_rows(state, g, "BagItems", r0, r1)
+    assert store.record_get(state, g, "BagItems", r0, "ItemConfig") == "sword"
+    assert store.record_get(state, g, "BagItems", r1, "ItemConfig") == "potion"
+    assert store.record_get(state, g, "BagItems", r0, "Count") == 1
+    assert store.record_get(state, g, "BagItems", r1, "Count") == 3
+
+
+def test_swap_with_empty_row_moves_used_flag(store):
+    state, g = _spawn_player(store)
+    state, r0 = store.record_add_row(
+        state, g, "BagItems", {"ItemConfig": "potion", "Count": 3}
+    )
+    target = r0 + 4
+    state = store.record_swap_rows(state, g, "BagItems", r0, target)
+    _, prow = store.row_of(g)
+    used = np.asarray(state.classes["Player"].records["BagItems"].used[prow])
+    assert not used[r0] and used[target]
+    assert store.record_get(state, g, "BagItems", target, "Count") == 3
+
+
+def test_bulk_write_rows_fires_one_batch_event(store):
+    state = store.init_state()
+    guids = []
+    state, gs, rows = store.create_many(state, "Player", 4)
+    events = []
+    store.subscribe_records(
+        lambda c, r, op, erows, rr, tags: events.append((op, erows.tolist(), tags))
+    )
+    state = store.record_write_rows(
+        state, "Player", rows, "BagItems", 0,
+        {"ItemConfig": ["a", "b", "c", "d"], "Count": [1, 2, 3, 4]},
+    )
+    assert len(events) == 1
+    op, erows, tags = events[0]
+    assert op == RecordOp.UPDATE and sorted(erows) == sorted(rows.tolist())
+    assert set(tags) == {"ItemConfig", "Count"}
+
+
+# ------------------------------------------------------------- device diffs
+
+
+class _RecMutator(Module):
+    """Device phase that bumps Count in row 0 and clears row 1's used flag
+    for every alive player — a stand-in for buff-expiry-style record
+    mutation inside the jitted tick."""
+
+    name = "RecMutator"
+
+    def __init__(self):
+        super().__init__()
+        self.add_phase("mutate", self._phase, order=50)
+
+    def _phase(self, state, ctx):
+        spec = ctx.store.spec("Player")
+        rs = spec.records["BagItems"]
+        cs = state.classes["Player"]
+        rec = cs.records["BagItems"]
+        count_col = rs.cols["Count"].col
+        alive = cs.alive
+        i32 = rec.i32.at[:, 0, count_col].add(
+            jnp.where(alive & rec.used[:, 0], 1, 0)
+        )
+        used = rec.used.at[:, 1].set(rec.used[:, 1] & ~alive)
+        rec = rec.replace(i32=i32, used=used)
+        return with_class(
+            state, "Player", cs.replace(records={**cs.records, "BagItems": rec})
+        )
+
+
+def _build_kernel():
+    reg = base_registry()
+    k = Kernel(
+        reg,
+        StoreConfig(default_capacity=16),
+        class_names=["IObject", "Player", "NPC"],
+        diff_flags=("public", "private", "upload"),
+    )
+    mut = _RecMutator()
+    k.build([k, mut])
+    return k
+
+
+def test_device_record_diff_codes():
+    k = _build_kernel()
+    g = k.create_object("Player", {"Name": "p"})
+    _, row = k.store.row_of(g)
+    k.state, _ = k.store.record_add_row(
+        k.state, g, "BagItems", {"ItemConfig": "potion", "Count": 1}
+    )
+    k.state, _ = k.store.record_add_row(
+        k.state, g, "BagItems", {"ItemConfig": "scroll", "Count": 9}
+    )
+    seen = []
+    k.register_record_diff(
+        "Player", "BagItems", lambda c, r, codes: seen.append(codes.copy())
+    )
+    k.tick()
+    assert len(seen) == 1
+    codes = seen[0]
+    assert codes[row, 0] == REC_UPDATED  # Count bumped on device
+    assert codes[row, 1] == REC_REMOVED  # used cleared on device
+    # host value reflects the device write
+    assert k.store.record_get(k.state, g, "BagItems", 0, "Count") == 2
+
+
+def test_unsubscribed_records_emit_no_diff():
+    k = _build_kernel()
+    k.create_object("Player", {"Name": "p"})
+    out = k.tick()
+    assert out.rec_diff == {} and out.rec_diff_count == {}
+
+
+def test_host_add_not_double_reported_by_device_diff():
+    """A host-path record add lands in `old` before the next trace, so the
+    device diff must NOT re-report it."""
+    k = _build_kernel()
+    g = k.create_object("Player", {"Name": "p"})
+    _, row = k.store.row_of(g)
+    seen = []
+    k.register_record_diff(
+        "Player", "BagItems", lambda c, r, codes: seen.append(codes.copy())
+    )
+    k.state, _ = k.store.record_add_row(
+        k.state, g, "BagItems", {"ItemConfig": "potion", "Count": 1}
+    )
+    k.tick()
+    # only the device mutation (UPDATE on row 0) shows; no ADDED code
+    assert seen and seen[0][row, 0] == REC_UPDATED
+    assert not (seen[0] == REC_ADDED).any()

@@ -161,6 +161,97 @@ def _guid_of(client):
     return Guid(client.player_guid.svrid, client.player_guid.index)
 
 
+def test_bag_record_sync_mid_session(cluster):
+    """Round-1 gap: a bag change during play must reach the owning client
+    (reference record events -> NFCGameServerNet_ServerModule.cpp:75-81)."""
+    c = full_login(cluster, "dave", "Dave")
+    game = cluster.game
+    guid = _guid_of(c)
+    pack = game.game_world.pack
+    key = (c.player_guid.svrid, c.player_guid.index)
+
+    assert pack.create_item(guid, "potion_small", 3)
+    drive_client(
+        cluster, c,
+        lambda: c.objects.get(key) is not None
+        and c.objects[key].records.get("BagItemList"),
+    )
+    cells = c.objects[key].records["BagItemList"]
+    # col_order: ConfigID=0, ItemCount=1
+    row = next(r for (r, col), v in cells.items() if col == 0 and v == "potion_small")
+    assert cells[(row, 1)] == 3
+
+    # stacking the same item updates the count cell (ACK_RECORD_INT)
+    assert pack.create_item(guid, "potion_small", 2)
+    drive_client(cluster, c, lambda: cells.get((row, 1)) == 5)
+
+    # consuming everything removes the row (ACK_REMOVE_ROW)
+    assert pack.delete_item(guid, "potion_small", 5)
+    drive_client(cluster, c, lambda: (row, 0) not in cells)
+    c.close()
+    drive_client(cluster, c, lambda: not any(
+        s.guid is not None and s.account == "dave"
+        for s in game.sessions.values()
+    ))
+
+
+def test_swap_interleaved_with_remove_converges(cluster):
+    """Swap + remove on the same rows within one frame must leave the
+    client mirror equal to the server's final record state (flush resyncs
+    swap-touched rows from final state instead of replaying op order)."""
+    c = full_login(cluster, "gina", "Gina")
+    game = cluster.game
+    guid = _guid_of(c)
+    k = game.kernel
+    key = (c.player_guid.svrid, c.player_guid.index)
+    k.state, r0 = k.store.record_add_row(
+        k.state, guid, "BagItemList", {"ConfigID": "apple", "ItemCount": 1})
+    k.state, r1 = k.store.record_add_row(
+        k.state, guid, "BagItemList", {"ConfigID": "pear", "ItemCount": 2})
+    drive_client(
+        cluster, c,
+        lambda: c.objects.get(key) is not None
+        and (r1, 0) in c.objects[key].records.get("BagItemList", {}),
+    )
+    # same frame: swap the rows, then remove r0 (which now holds "pear")
+    k.state = k.store.record_swap_rows(k.state, guid, "BagItemList", r0, r1)
+    k.state = k.store.record_remove_row(k.state, guid, "BagItemList", r0)
+    cells = c.objects[key].records["BagItemList"]
+    drive_client(cluster, c, lambda: (r0, 0) not in cells)
+    assert cells[(r1, 0)] == "apple"
+    assert cells[(r1, 1)] == 1
+    c.close()
+    drive_client(cluster, c, lambda: not any(
+        s.guid is not None and s.account == "gina"
+        for s in game.sessions.values()
+    ))
+
+
+def test_private_property_syncs_to_owner_only(cluster):
+    """Private-only props (EXP/Gold) reach the owner's mirror but not other
+    clients (GetBroadCastObject: Private -> self)."""
+    a = full_login(cluster, "erin", "Erin")
+    b = full_login(cluster, "frank", "Frank")
+
+    class _Both:
+        def execute(self):
+            a.execute()
+            b.execute()
+
+    both = _Both()
+    akey = (a.player_guid.svrid, a.player_guid.index)
+    drive_client(cluster, both, lambda: akey in b.objects)
+    cluster.game.kernel.set_property(_guid_of(a), "Gold", 777)
+    drive_client(
+        cluster, both,
+        lambda: a.objects.get(akey) is not None
+        and a.objects[akey].properties.get("Gold") == 777,
+    )
+    assert b.objects[akey].properties.get("Gold") != 777
+    a.close()
+    b.close()
+
+
 def test_unauthed_proxy_messages_dropped(cluster):
     c = GameClient("mallory")
     c.connect("127.0.0.1", cluster.proxy.config.port)
