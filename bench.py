@@ -101,6 +101,95 @@ def _grid_overflow_max(world) -> int:
         return -1
 
 
+def run_served(args) -> dict:
+    """The SERVED path: kernel.tick() with host observation + the game
+    role's full per-frame sync flush (diff fetch, message serialization,
+    envelope encode, broadcast fan-out to S sessions) — the cost a real
+    game server pays per frame, which run_device excludes (round-1 weak
+    #4: benchmark path != served path).  Transport writes are captured
+    into a byte counter instead of sockets."""
+    import jax
+
+    from noahgameframe_tpu.core.datatypes import Guid  # noqa: F401
+    from noahgameframe_tpu.game import build_benchmark_world
+    from noahgameframe_tpu.net.roles.base import RoleConfig
+    from noahgameframe_tpu.net.roles.game import GameRole, Session
+    from noahgameframe_tpu.net.wire import Ident, ident_key
+
+    n = args.entities
+    world = build_benchmark_world(n, combat=not args.no_combat, seed=42)
+    role = GameRole(
+        RoleConfig(6, 0, "BenchGame", "127.0.0.1", 0),
+        backend="py",
+        world=world,
+        cross_server_sync=False,
+    )
+    sent = {"msgs": 0, "bytes": 0}
+
+    def fake_send(conn_id: int, msg_id: int, body: bytes) -> bool:
+        sent["msgs"] += 1
+        sent["bytes"] += len(body)
+        return True
+
+    role.server.send_raw = fake_send
+    # S simulated sessions with live Player avatars in the NPC scene
+    n_sessions = args.sessions
+    for i in range(n_sessions):
+        ident = Ident(svrid=99, index=i + 1)
+        sess = Session(ident=ident, conn_id=1000 + (i % 8), account=f"bot{i}")
+        g = role.kernel.create_object("Player", {"Name": f"Bot{i}"},
+                                      scene=1, group=0)
+        sess.guid = g
+        role.sessions[ident_key(ident)] = sess
+        role._guid_session[g] = ident_key(ident)
+
+    dt = world.config.dt * 1.0001  # epsilon: defeat float >= dt jitter
+    now = 1000.0
+    # warm up: compile + first flush
+    for _ in range(3):
+        now += dt
+        role.execute(now)
+    jax.block_until_ready(role.kernel.state.classes["NPC"].i32)
+    sent["msgs"] = sent["bytes"] = 0
+    frame_ms = []
+    t_all = time.perf_counter()
+    for _ in range(args.ticks):
+        now += dt
+        t0 = time.perf_counter()
+        role.execute(now)
+        jax.block_until_ready(role.kernel.state.classes["NPC"].i32)
+        frame_ms.append(1000 * (time.perf_counter() - t0))
+    elapsed = time.perf_counter() - t_all
+    frame_sorted = sorted(frame_ms)
+
+    def pct(p: float) -> float:
+        i = min(len(frame_sorted) - 1,
+                int(round(p / 100 * (len(frame_sorted) - 1))))
+        return round(frame_sorted[i], 3)
+
+    rate = n * args.ticks / elapsed
+    dev = __import__("jax").devices()[0]
+    return {
+        "metric": "served_entity_ticks_per_sec_per_chip",
+        "value": round(rate, 1),
+        "unit": "entity-ticks/s",
+        "vs_baseline": round(rate / NORTH_STAR_RATE, 4),
+        "detail": {
+            "entities": n,
+            "ticks": args.ticks,
+            "sessions": n_sessions,
+            "elapsed_s": round(elapsed, 4),
+            "frame_ms_p50": pct(50),
+            "frame_ms_p95": pct(95),
+            "frame_ms_p99": pct(99),
+            "sync_msgs": sent["msgs"],
+            "sync_bytes": sent["bytes"],
+            "device": str(dev),
+            "platform": dev.platform,
+        },
+    }
+
+
 def run_bench(args) -> dict:
     import jax
 
@@ -176,6 +265,12 @@ def main() -> None:
     ap.add_argument("--ticks", type=int, default=None)
     ap.add_argument("--no-combat", action="store_true")
     ap.add_argument(
+        "--served", action="store_true",
+        help="measure the served path (tick + diff flush + fan-out) "
+             "instead of the fused device loop",
+    )
+    ap.add_argument("--sessions", type=int, default=50)
+    ap.add_argument(
         "--platform",
         choices=("auto", "tpu", "cpu"),
         default="auto",
@@ -208,7 +303,7 @@ def main() -> None:
         args.ticks = 90
 
     try:
-        payload = run_bench(args)
+        payload = run_served(args) if args.served else run_bench(args)
         if probe_note:
             payload["detail"]["accelerator_probe_error"] = probe_note
             payload["detail"]["platform_fallback"] = "cpu"

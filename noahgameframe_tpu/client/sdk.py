@@ -132,6 +132,7 @@ class GameClient:
         h[int(MsgID.ACK_RECORD_STRING)] = self._on_record_string
         h[int(MsgID.ACK_RECORD_OBJECT)] = self._on_record_object
         h[int(MsgID.ACK_RECORD_VECTOR3)] = self._on_record_vector3
+        h[int(MsgID.ACK_BATCH_PROPERTY)] = self._on_batch_property
         h[int(MsgID.ACK_MOVE)] = self._on_move
         h[int(MsgID.ACK_CHAT)] = self._on_chat
         h[int(MsgID.ACK_SKILL_OBJECTX)] = self._on_skill
@@ -435,6 +436,35 @@ class GameClient:
             cells[(c.row, c.col)] = (
                 (v.x, v.y, v.z) if v is not None else (0.0, 0.0, 0.0)
             )
+
+    def _on_batch_property(self, base: MsgBase) -> None:
+        """Columnar batch sync (TPU-native extension): unpack the arrays
+        and fold each entity's value into the mirror."""
+        import numpy as np
+
+        from ..net.wire import BatchPropertySync
+
+        msg = BatchPropertySync.decode(base.msg_data)
+        heads = np.frombuffer(msg.svrid, np.int64)
+        datas = np.frombuffer(msg.index, np.int64)
+        name = msg.property_name.decode()
+        t = msg.ptype
+        if t == 5 or t == 6:  # VECTOR2 / VECTOR3 ride as float32[n*3]
+            vals = np.frombuffer(msg.data, np.float32).reshape(-1, 3)
+            vals = [
+                (float(v[0]), float(v[1])) if t == 5
+                else (float(v[0]), float(v[1]), float(v[2]))
+                for v in vals
+            ]
+        elif t == 2:  # FLOAT
+            vals = [float(v) for v in np.frombuffer(msg.data, np.float32)]
+        else:  # INT
+            vals = [int(v) for v in np.frombuffer(msg.data, np.int32)]
+        for h_, d_, v in zip(heads.tolist(), datas.tolist(), vals):
+            o = self._obj(Ident(svrid=h_, index=d_))
+            o.properties[name] = v
+            if name == "Position":
+                o.position = v if len(v) == 3 else (*v, 0.0)
 
     # ------------------------------------------------------------- gameplay
     def move_to(self, x: float, y: float, z: float = 0.0) -> None:

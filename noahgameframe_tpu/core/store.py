@@ -187,6 +187,10 @@ class _ClassHost:
         # host-side allocation bitmap: lets reconcile_deaths find device
         # deaths with ONE vector op instead of a Python scan of every row
         self.alloc_mask = np.zeros(capacity, bool)
+        # columnar guid mirror of row_guid — the batch sync path reads
+        # guid identities for thousands of rows with one gather
+        self.guid_head = np.zeros(capacity, np.int64)
+        self.guid_data = np.zeros(capacity, np.int64)
         self.live_count = 0
 
     def alloc(self) -> int:
@@ -217,6 +221,8 @@ class _ClassHost:
         self.row_guid[row] = None
         self.free.append(row)
         self.alloc_mask[row] = False
+        self.guid_head[row] = 0
+        self.guid_data[row] = 0
         self.live_count -= 1
 
 
@@ -422,6 +428,8 @@ class EntityStore:
         for g, row in zip(out_guids, rows.tolist()):
             self.guid_map[g] = pack_handle(ci, row)
             host.row_guid[row] = g
+        host.guid_head[rows] = np.fromiter((g.head for g in out_guids), np.int64, n)
+        host.guid_data[rows] = np.fromiter((g.data for g in out_guids), np.int64, n)
 
         cs = state.classes[class_name]
         # fully reset the rows: banks to defaults/overrides, timers off, and

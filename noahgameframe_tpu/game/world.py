@@ -88,11 +88,12 @@ class GameWorld:
             diff_flags=cfg.diff_flags,
         )
         self.scene = SceneModule()
+        self.components = ComponentModule()
         self.property_config = PropertyConfigModule()
         self.properties = PropertyModule()
         self.level = LevelModule(self.property_config, self.properties)
         self.skills = SkillModule()
-        modules = [self.kernel, self.scene, self.property_config, self.properties, self.level, self.skills]
+        modules = [self.kernel, self.scene, self.components, self.property_config, self.properties, self.level, self.skills]
         self.pack = self.items = self.equip = self.heroes = self.tasks = None
         self.buffs = self.team = self.mail = self.rank = self.shop = None
         self.friends = self.guilds = self.gm = self.pvp = None

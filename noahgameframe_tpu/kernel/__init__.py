@@ -1,4 +1,6 @@
 from .actor import ActorModule, AsyncSqlModule, Component
+from .component import Component as ObjectComponent
+from .component import ComponentModule
 from .events import DeviceEvent, EventModule
 from .kernel import Kernel, ObjectEvent, TickCtx, TickOutputs
 from .module import Module, Phase
@@ -9,10 +11,12 @@ __all__ = [
     "ActorModule",
     "AsyncSqlModule",
     "Component",
+    "ComponentModule",
     "DeviceEvent",
     "EventModule",
     "Kernel",
     "Module",
+    "ObjectComponent",
     "ObjectEvent",
     "Phase",
     "Plugin",

@@ -128,6 +128,11 @@ class MsgID(enum.IntEnum):
     ACK_RECORD_VECTOR3 = 229
     ACK_RECORD_CLEAR = 250
     ACK_RECORD_SORT = 251
+    # TPU-native extension (outside the reference EGameMsgID space):
+    # columnar batch property sync — one message carries every changed
+    # entity's value for one (class, property) as packed arrays, replacing
+    # tens of thousands of per-entity messages per frame at 100k+ scale
+    ACK_BATCH_PROPERTY = 8001
 
     # in-game actions
     REQ_MOVE = 1230

@@ -126,7 +126,12 @@ class GameRole(ServerRole):
         role_store=None,
         autosave_seconds: float = 30.0,
         cross_server_sync: bool = True,
+        batch_sync_min: int = 256,
     ) -> None:
+        # (class, prop) diffs with >= batch_sync_min changed rows go out
+        # as ONE columnar ACK_BATCH_PROPERTY message per (cell, conn)
+        # instead of per-entity messages — the served-path fast lane
+        self.batch_sync_min = batch_sync_min
         self.game_world = world if world is not None else GameWorld(
             WorldConfig(combat=False, movement=False, regen=True)
         ).start()
@@ -844,12 +849,29 @@ class GameRole(ServerRole):
         are row-subset gathers done once per class per frame."""
         k = self.kernel
         changed, self._changed = self._changed, {}
+        player_idx = self._build_player_index()
+        # columnar fast lane: large public scalar/vector diffs leave as
+        # packed-array batches (100k movers = a handful of messages, not
+        # 100k python serializations)
+        if self.batch_sync_min > 0:
+            for key in [
+                kk for kk, rows in changed.items()
+                if rows.size >= self.batch_sync_min
+            ]:
+                cname, pname = key
+                p = k.store.spec(cname).slot(pname).prop
+                if p.public and p.type in (
+                    DataType.INT, DataType.FLOAT,
+                    DataType.VECTOR2, DataType.VECTOR3,
+                ):
+                    self._send_batch_property(
+                        cname, pname, changed.pop(key), player_idx
+                    )
         # regroup per (class, row) so each entity sends one message per kind
         per_entity: Dict[Tuple[str, int], List[str]] = {}
         for (cname, pname), rows in changed.items():
             for row in rows:
                 per_entity.setdefault((cname, int(row)), []).append(pname)
-        player_idx = self._build_player_index()
         rows_by_class: Dict[str, np.ndarray] = {}
         for cname, row in per_entity:
             rows_by_class.setdefault(cname, []).append(row)
@@ -900,6 +922,59 @@ class GameRole(ServerRole):
                     forward=(public and cname == "Player"),
                 )
         self._flush_records(player_idx)
+
+    def _send_batch_property(self, cname: str, pname: str, rows: np.ndarray,
+                             player_idx) -> None:
+        """Columnar sync: ONE gather off the device + packed-array message
+        per (scene, group) cell with observers.  This is the wire mirror
+        of the SoA store — the per-entity proto path stays for strings,
+        objects, private props and small diffs."""
+        from ...kernel.scene import MAX_GROUPS_PER_SCENE
+        from ..wire import BatchPropertySync
+
+        k = self.kernel
+        host = k.store._hosts[cname]
+        spec = k.store.spec(cname)
+        slot = spec.slot(pname)
+        rows = rows[host.alloc_mask[rows]]  # drop rows that died
+        if rows.size == 0:
+            return
+        cells = self._rows_cells(cname, rows)  # [n, 2]
+        cs = k.state.classes[cname]
+        if slot.bank == Bank.VEC:
+            vals = np.asarray(cs.vec[rows, slot.col], np.float32)  # [n, 3]
+        elif slot.bank == Bank.F32:
+            vals = np.asarray(cs.f32[rows, slot.col], np.float32)
+        else:
+            vals = np.asarray(cs.i32[rows, slot.col], np.int32)
+        heads = host.guid_head[rows]
+        datas = host.guid_data[rows]
+        cell_ids = cells[:, 0].astype(np.int64) * MAX_GROUPS_PER_SCENE + cells[:, 1]
+        order = np.argsort(cell_ids, kind="stable")
+        sorted_ids = cell_ids[order]
+        uniq, starts = np.unique(sorted_ids, return_index=True)
+        bounds = list(starts.tolist()) + [len(order)]
+        name_b = pname.encode()
+        cls_b = cname.encode()
+        ptype = int(slot.prop.type)
+        for i, cid in enumerate(uniq.tolist()):
+            sc, gr = divmod(int(cid), MAX_GROUPS_PER_SCENE)
+            targets = self._targets_from_index(
+                player_idx, None, sc, gr, True, cname
+            )
+            if not targets:
+                continue
+            seg = order[bounds[i]:bounds[i + 1]]
+            msg = BatchPropertySync(
+                class_name=cls_b,
+                property_name=name_b,
+                ptype=ptype,
+                count=int(seg.size),
+                svrid=heads[seg].tobytes(),
+                index=datas[seg].tobytes(),
+                data=np.ascontiguousarray(vals[seg]).tobytes(),
+            )
+            self._broadcast(targets, MsgID.ACK_BATCH_PROPERTY, msg)
 
     def _forward_world(self, msg_id: int, msg: Message, pid: Ident) -> None:
         """Push a sync message up the world link for cross-game relay."""

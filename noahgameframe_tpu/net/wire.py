@@ -607,6 +607,26 @@ class ServerHeartBeat(Message):
     FIELDS = [(1, "count", "int32", 0)]
 
 
+class BatchPropertySync(Message):
+    """TPU-native columnar sync (msg id ACK_BATCH_PROPERTY, outside the
+    reference message space): every changed entity's value for ONE
+    (class, property), packed as little-endian arrays — the wire mirror
+    of the SoA store.  `ptype` is the DataType enum; `data` holds
+    int32[n] / float32[n] / float32[n*3] depending on ptype; guids ride
+    as i64 pairs.  Encoding stays valid proto2 (bytes fields), so
+    unaware reference clients skip it cleanly by field type."""
+
+    FIELDS = [
+        (1, "class_name", "bytes", b""),
+        (2, "property_name", "bytes", b""),
+        (3, "ptype", "int32", 0),
+        (4, "count", "int32", 0),
+        (5, "svrid", "bytes", b""),  # i64le[n]
+        (6, "index", "bytes", b""),  # i64le[n]
+        (7, "data", "bytes", b""),
+    ]
+
+
 class RoleOnlineNotify(Message):
     """Game → World: a player came online (player guid rides the MsgBase
     envelope; `NFMsgPreGame.proto` RoleOnlineNotify)."""

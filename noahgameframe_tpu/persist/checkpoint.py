@@ -164,10 +164,17 @@ def load_world(kernel: Kernel, path: Path, modules=()) -> None:
             Guid.parse(s) if s else None for s in hmeta["row_guid"]
         ]
         host.live_count = int(hmeta["live_count"])
-        # alloc_mask is derived state — rebuild from row_guid, else
-        # reconcile_deaths/_build_player_index see the pre-load allocation
+        # alloc_mask / guid columns are derived state — rebuild from
+        # row_guid, else reconcile_deaths/_build_player_index and the
+        # batch sync path see the pre-load allocation
         host.alloc_mask = np.asarray(
             [g is not None for g in host.row_guid], bool
+        )
+        host.guid_head = np.asarray(
+            [g.head if g is not None else 0 for g in host.row_guid], np.int64
+        )
+        host.guid_data = np.asarray(
+            [g.data if g is not None else 0 for g in host.row_guid], np.int64
         )
     mod_states = meta.get("modules", {})
     for m in modules:

@@ -34,6 +34,19 @@ def test_bench_smoke_emits_parseable_json():
     assert "tick_ms_p99" in d["detail"]
 
 
+def test_bench_served_smoke():
+    r = _run(
+        ["bench.py", "--served", "--platform", "cpu",
+         "--entities", "2000", "--ticks", "4", "--sessions", "5"],
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    d = json.loads(r.stdout.strip().splitlines()[-1])
+    assert d["metric"] == "served_entity_ticks_per_sec_per_chip"
+    assert d["value"] > 0
+    assert d["detail"]["sync_msgs"] > 0  # fan-out actually happened
+
+
 def test_dryrun_multichip_forces_cpu_and_finishes():
     r = _run(["__graft_entry__.py", "multichip", "4"], timeout=180)
     assert r.returncode == 0, r.stderr[-2000:]

@@ -252,6 +252,35 @@ def test_private_property_syncs_to_owner_only(cluster):
     b.close()
 
 
+def test_batch_property_sync_reaches_client(cluster):
+    """The columnar ACK_BATCH_PROPERTY lane (TPU-native extension) must
+    land values in the client mirror exactly like the per-entity path."""
+    game = cluster.game
+    old_min = game.batch_sync_min
+    game.batch_sync_min = 1  # force every diff through the batch lane
+    try:
+        c = full_login(cluster, "hana", "Hana")
+        key = (c.player_guid.svrid, c.player_guid.index)
+        game.kernel.set_property(_guid_of(c), "Position", (5.0, 6.0, 7.0))
+        drive_client(
+            cluster, c,
+            lambda: c.objects.get(key) is not None
+            and c.objects[key].properties.get("Position") == (5.0, 6.0, 7.0),
+        )
+        game.kernel.set_property(_guid_of(c), "Level", 4)
+        drive_client(
+            cluster, c,
+            lambda: c.objects[key].properties.get("Level") == 4,
+        )
+    finally:
+        game.batch_sync_min = old_min
+        c.close()
+        drive_client(cluster, c, lambda: not any(
+            s.guid is not None and s.account == "hana"
+            for s in game.sessions.values()
+        ))
+
+
 def test_unauthed_proxy_messages_dropped(cluster):
     c = GameClient("mallory")
     c.connect("127.0.0.1", cluster.proxy.config.port)
