@@ -17,6 +17,7 @@ import numpy as np
 
 from ..core.schema import ClassRegistry
 from ..core.store import StoreConfig
+from ..kernel.component import ComponentModule
 from ..kernel.kernel import Kernel
 from ..kernel.plugin import Plugin, PluginManager
 from ..kernel.scene import SceneModule
