@@ -75,17 +75,15 @@ def _emit(payload: dict) -> None:
 
 
 def _grid_overflow_max(world) -> int:
-    """Rebuild the AOI grid from the final state once (outside the timed
-    region) and report entities dropped by bucket overflow — silent drops
-    were a round-1 finding (ops/aoi.py scatter mode='drop').
-
-    Upper bound: built over all alive entities, while the combat phase
-    only grids the attacking subset (alive & timer-fired & hp>0), so real
-    per-tick drops are <= this."""
+    """Rebuild the combat cell-table from the final state once (outside
+    the timed region) and report entities dropped by bucket overflow —
+    silent drops were a round-1 finding.  This is exactly the table the
+    combat phase builds (all alive entities, auto-sized buckets), so it is
+    the real per-tick drop count, not an upper bound."""
     try:
-        import jax.numpy as jnp  # noqa: F401
+        import jax.numpy as jnp
 
-        from noahgameframe_tpu.ops.aoi import build_grid, grid_overflow
+        from noahgameframe_tpu.ops.stencil import build_cell_table
 
         combat = getattr(world, "combat", None)
         if combat is None:
@@ -95,8 +93,17 @@ def _grid_overflow_max(world) -> int:
         spec = store.spec(cname)
         cs = world.kernel.state.classes[cname]
         pos = cs.vec[:, spec.slot("Position").col, :2]
-        grid = build_grid(pos, cs.alive, combat.cell_size, combat.width, combat.bucket)
-        return int(grid_overflow(grid))
+        n = pos.shape[0]
+        bucket = combat.resolved_bucket(n)
+        table = build_cell_table(
+            pos,
+            cs.alive,
+            jnp.zeros((n, 0), jnp.float32),
+            combat.cell_size,
+            combat.width,
+            bucket,
+        )
+        return int(table.dropped)
     except Exception:  # noqa: BLE001
         return -1
 

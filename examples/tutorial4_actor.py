@@ -17,7 +17,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from noahgameframe_tpu.kernel import ActorModule, Component
+from noahgameframe_tpu.kernel import ActorComponent, ActorModule
 
 MSG_HEAVY_MATH = 1
 
@@ -25,7 +25,7 @@ MSG_HEAVY_MATH = 1
 def main() -> None:
     actors = ActorModule(threads=2)
 
-    comp = Component()
+    comp = ActorComponent()
 
     def heavy_math(_msg_id: int, n: int) -> int:
         time.sleep(0.01)  # pretend this is expensive IO / crunching

@@ -9,10 +9,11 @@ Reference behavior being matched:
   from its seed/config (NFCNPCRefreshModule.cpp:115-135 and
   OnDeadDestroyHeart).
 
-TPU inversion (BASELINE config 4's 1M-entity AoE resolve): attackers whose
-`Attack` timer fired are binned into the uniform grid (ops/aoi.py); every
-entity then PULLS incoming damage from the 3x3-stencil candidates within
-the skill radius — a gather-reduce with zero scatter collisions — applies
+TPU inversion (BASELINE config 4's 1M-entity AoE resolve): all alive
+entities are binned once into the cell-table (ops/stencil.py — one sort,
+one scatter); every entity then PULLS incoming damage from the nine
+dense-shifted neighbor blocks within the skill radius — a fused pairwise
+masked reduction with zero gathers and zero scatter collisions — applies
 `max(sum_atk - def, 0)`, picks the strongest in-range attacker as
 LastAttacker, and the death sweep emits one batched BE_KILLED event and
 arms device-side respawn (HP restored after `respawn_s`, keeping the row;
@@ -28,7 +29,7 @@ import jax.numpy as jnp
 from ..core.datatypes import Guid
 from ..core.store import HANDLE_ROW_BITS, WorldState, with_class
 from ..kernel.module import Module
-from ..ops.aoi import build_grid, cell_of, neighbor_candidates
+from ..ops.stencil import auto_bucket, build_cell_table, pull, stencil_fold
 from .defines import GameEvent
 
 ATTACK_TIMER = "Attack"
@@ -45,7 +46,7 @@ class CombatModule(Module):
         extent: float = 512.0,
         radius: float = 4.0,
         cell_size: Optional[float] = None,
-        bucket: int = 8,
+        bucket: Optional[int] = None,
         respawn_s: float = 5.0,
         attack_period_s: float = 1.0,
         order: int = 30,
@@ -57,7 +58,9 @@ class CombatModule(Module):
         self.radius = float(radius)
         self.cell_size = float(cell_size if cell_size is not None else max(radius, 1.0))
         self.width = max(1, int(self.extent / self.cell_size))
-        self.bucket = int(bucket)
+        # None = size buckets from capacity/cell density at trace time so
+        # overflow (entities silently missing combat) stays ~zero
+        self.bucket = None if bucket is None else int(bucket)
         self.respawn_s = float(respawn_s)
         self.attack_period_s = float(attack_period_s)
         self.emit_events = emit_events
@@ -79,6 +82,15 @@ class CombatModule(Module):
         rows = np.flatnonzero(np.asarray(cs.alive))
         k.state = k.schedule.set_timer_rows(
             k.state, self.class_name, rows, ATTACK_TIMER, self.attack_period_s
+        )
+
+    def resolved_bucket(self, capacity: int) -> int:
+        """The cell-table bucket size the combat phase actually uses —
+        shared with bench.py's overflow monitor so both stay in sync."""
+        return (
+            self.bucket
+            if self.bucket is not None
+            else auto_bucket(capacity, self.width)
         )
 
     # -- device phases -------------------------------------------------------
@@ -108,29 +120,85 @@ class CombatModule(Module):
         # combat is (scene, group)-scoped like every broadcast in the
         # reference (NFCSceneAOIModule::GetBroadCastObject) — entities at
         # overlapping coordinates in different cells never interact
-        from ..kernel.scene import MAX_GROUPS_PER_SCENE
-
-        cell_key = (
-            cs.i32[:, spec.slot("SceneID").col] * MAX_GROUPS_PER_SCENE
-            + cs.i32[:, spec.slot("GroupID").col]
+        n = pos.shape[0]
+        bucket = self.resolved_bucket(n)
+        # One table over all alive entities; non-attackers carry eff_atk 0
+        # and are masked out on the candidate side.  f32 carries each int
+        # column exactly for values < 2^24 (row < capacity, atk, scene id,
+        # group id — scene and group ride in separate columns so neither
+        # magnitude compounds); per-shift damage sums stay < 2^24 because
+        # a shift has at most K candidates, and the cross-shift total
+        # accumulates in exact int32.  Victims beyond a cell's K slots are
+        # dropped (invisible AND invulnerable) that tick; `auto_bucket`
+        # keeps that ~zero, and CellTable.dropped counts it.
+        f32 = jnp.float32
+        eff_atk = jnp.where(attacking, atk, 0).astype(f32)
+        scene_f = cs.i32[:, spec.slot("SceneID").col].astype(f32)
+        group_f = cs.i32[:, spec.slot("GroupID").col].astype(f32)
+        feats = jnp.stack(
+            [
+                pos[:, 0],
+                pos[:, 1],
+                eff_atk,
+                camp.astype(f32),
+                scene_f,
+                group_f,
+                jnp.arange(n, dtype=f32),
+            ],
+            axis=-1,
         )
-
-        grid = build_grid(pos, attacking, self.cell_size, self.width, self.bucket)
-        qcell = cell_of(pos, self.cell_size, self.width)
-        cand = neighbor_candidates(qcell, grid)  # [C, 9K]
-        safe = jnp.maximum(cand, 0)
-        d = pos[:, None, :] - pos[safe]
-        in_range = jnp.sum(d * d, axis=-1) <= self.radius * self.radius
-        valid = (
-            (cand >= 0)
-            & in_range
-            & (cand != jnp.arange(pos.shape[0], dtype=jnp.int32)[:, None])
-            & (camp[safe] != camp[:, None])  # no friendly fire
-            & (cell_key[safe] == cell_key[:, None])  # same (scene, group)
-            & cs.alive[:, None]
-            & (hp[:, None] > 0)
+        table = build_cell_table(
+            pos, cs.alive, feats, self.cell_size, self.width, bucket
         )
-        incoming = jnp.sum(jnp.where(valid, atk[safe], 0), axis=-1)
+        v = table.grid_view()
+        vx, vy = v[..., 0], v[..., 1]
+        vcamp, vscene, vgroup, vrow = v[..., 3], v[..., 4], v[..., 5], v[..., 6]
+        r2 = self.radius * self.radius
+        idt = jnp.int32
+
+        def fold(acc, cand):
+            inc, besta, bestr = acc
+            cx = cand[:, :, None, :, 0]
+            cy = cand[:, :, None, :, 1]
+            ca = cand[:, :, None, :, 2]
+            cc = cand[:, :, None, :, 3]
+            cscene = cand[:, :, None, :, 4]
+            cgroup = cand[:, :, None, :, 5]
+            cr = cand[:, :, None, :, 6]
+            dx = vx[..., None] - cx
+            dy = vy[..., None] - cy
+            ok = (
+                (dx * dx + dy * dy <= r2)
+                & (ca != 0)  # attacking this tick (eff_atk 0 = bystander)
+                & (cc != vcamp[..., None])  # no friendly fire
+                & (cscene == vscene[..., None])  # same scene...
+                & (cgroup == vgroup[..., None])  # ...and group
+                & (cr != vrow[..., None])  # not self
+            )
+            inc = inc + jnp.sum(jnp.where(ok, ca, 0.0), axis=-1).astype(idt)
+            # strongest attacker; ties resolve to the first candidate in
+            # (stencil, slot) order — slots hold ascending rows, so the
+            # within-shift tie-break is min-row
+            sa = jnp.where(ok, ca, -1.0)
+            m = jnp.max(sa, axis=-1)
+            first = jnp.min(
+                jnp.where(sa >= m[..., None], cr, jnp.inf), axis=-1
+            )
+            better = m > besta
+            besta = jnp.where(better, m, besta)
+            bestr = jnp.where(better, first.astype(idt), bestr)
+            return inc, besta, bestr
+
+        zeros = jnp.zeros(v.shape[:3], idt)
+        inc, _besta, bestr = stencil_fold(
+            table,
+            fold,
+            (zeros, jnp.zeros(v.shape[:3], f32) - 1.0, zeros - 1),
+        )
+        pulled = pull(table, jnp.stack([inc, bestr], axis=-1), fill=(0, -1))
+        incoming = pulled[..., 0]
+        # dead-but-not-yet-respawned victims take no damage
+        incoming = jnp.where(cs.alive & (hp > 0), incoming, 0)
         dmg = jnp.maximum(incoming - deff, 0)
         dmg = jnp.where(incoming > 0, jnp.maximum(dmg, 1), 0)  # a hit always chips
         new_hp = jnp.maximum(hp - dmg, 0)
@@ -139,9 +207,7 @@ class CombatModule(Module):
         if spec.has_property("LastAttacker"):
             # strongest in-range attacker, packed as an object handle
             cls_idx = store.class_index[cname]
-            masked_atk = jnp.where(valid, atk[safe], -1)
-            best = jnp.argmax(masked_atk, axis=-1)
-            best_row = jnp.take_along_axis(cand, best[:, None], axis=-1)[:, 0]
+            best_row = pulled[..., 1]
             handle = (cls_idx << HANDLE_ROW_BITS) | jnp.maximum(best_row, 0)
             la_col = spec.slot("LastAttacker").col
             hit = incoming > 0

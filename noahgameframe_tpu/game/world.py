@@ -53,7 +53,7 @@ class WorldConfig:
     dt: float = 1.0 / 30.0
     seed: int = 0
     aoe_radius: float = 4.0
-    aoi_bucket: int = 8
+    aoi_bucket: Optional[int] = None  # None = auto-size from density
     respawn_s: float = 5.0
     attack_period_s: float = 1.0
     regen_period_s: float = 1.0

@@ -1,4 +1,4 @@
-from .actor import ActorModule, AsyncSqlModule, Component
+from .actor import ActorComponent, ActorModule, AsyncSqlModule
 from .component import Component as ObjectComponent
 from .component import ComponentModule
 from .events import DeviceEvent, EventModule
@@ -8,9 +8,9 @@ from .plugin import Plugin, PluginManager
 from .schedule import ScheduleModule
 
 __all__ = [
+    "ActorComponent",
     "ActorModule",
     "AsyncSqlModule",
-    "Component",
     "ComponentModule",
     "DeviceEvent",
     "EventModule",

@@ -32,7 +32,7 @@ HandlerFn = Callable[[int, Any], Any]
 EndFn = Callable[[int, int, Any], None]
 
 
-class Component:
+class ActorComponent:
     """Per-actor logic unit (reference NFIComponent / NFCMysqlComponent):
     register handlers per message id; runs on pool threads, so it must
     not touch world state — results flow back via the end functor."""
@@ -57,7 +57,7 @@ class Component:
 class _Actor:
     """One mailbox: messages execute in order on the shared pool."""
 
-    def __init__(self, actor_id: int, component: Component,
+    def __init__(self, actor_id: int, component: ActorComponent,
                  pool: ThreadPoolExecutor, done: "queue.Queue") -> None:
         self.actor_id = actor_id
         self.component = component
@@ -108,16 +108,16 @@ class ActorModule(Module):
         self._errors: List[Exception] = []
 
     # -- reference-parity API -------------------------------------------
-    def require_actor(self, component: Optional[Component] = None) -> int:
+    def require_actor(self, component: Optional[ActorComponent] = None) -> int:
         """Spawn an actor around `component` and return its id."""
         actor_id = self._next_id
         self._next_id += 1
         self._actors[actor_id] = _Actor(
-            actor_id, component or Component(), self._pool, self._done
+            actor_id, component or ActorComponent(), self._pool, self._done
         )
         return actor_id
 
-    def component(self, actor_id: int) -> Component:
+    def component(self, actor_id: int) -> ActorComponent:
         return self._actors[actor_id].component
 
     def send_to_actor(self, actor_id: int, msg_id: int, data: Any,
@@ -176,7 +176,7 @@ class ActorModule(Module):
         self._actors.clear()
 
 
-class AsyncSqlComponent(Component):
+class AsyncSqlComponent(ActorComponent):
     """Async relational persistence: each request runs on the actor,
     mirroring NFCAsyMysqlModule shipping serialized args to a
     NFCMysqlComponent on a pool actor (`NFCAsyMysqlModule.cpp:558-599`)."""

@@ -1,0 +1,165 @@
+"""Per-object component system: schema-driven attach at CREATE_FINISH,
+detach at BEFORE_DESTROY, enable flags, per-frame execute ordering
+(reference NFCObject::Execute -> NFCComponentManager, NFCObject.cpp:42-47,
+NFIComponent.h:16-80), and a scripted-NPC component over a device world."""
+
+import numpy as np
+
+from noahgameframe_tpu.core import ClassDef, ClassRegistry, prop
+from noahgameframe_tpu.core.schema import ComponentDef
+from noahgameframe_tpu.core.store import StoreConfig
+from noahgameframe_tpu.kernel import (
+    ComponentModule,
+    Kernel,
+    ObjectComponent,
+    Plugin,
+    PluginManager,
+)
+
+
+def component_registry() -> ClassRegistry:
+    reg = ClassRegistry()
+    reg.define(
+        ClassDef(
+            name="IObject",
+            properties=[
+                prop("SceneID", "int", private=True),
+                prop("GroupID", "int", private=True),
+                prop("ClassName", "string", private=True),
+            ],
+        )
+    )
+    reg.define(
+        ClassDef(
+            name="NPC",
+            parent="IObject",
+            properties=[prop("HP", "int", public=True), prop("Rage", "int")],
+            components=[
+                ComponentDef("Guard"),
+                ComponentDef("Berserk", enable=False),
+                ComponentDef("NoSuchCode"),  # schema names unregistered code
+            ],
+        )
+    )
+    return reg
+
+
+class TraceComponent(ObjectComponent):
+    log = []  # class-level trace shared by the test
+
+    def init(self):
+        TraceComponent.log.append((self.name, "init", self.guid))
+
+    def after_init(self):
+        TraceComponent.log.append((self.name, "after_init", self.guid))
+
+    def execute(self):
+        TraceComponent.log.append((self.name, "execute", self.guid))
+
+    def before_shut(self):
+        TraceComponent.log.append((self.name, "before_shut", self.guid))
+
+
+class Guard(TraceComponent):
+    name = "Guard"
+
+
+class Berserk(TraceComponent):
+    name = "Berserk"
+
+
+def build_world():
+    TraceComponent.log = []
+    k = Kernel(component_registry(), StoreConfig(default_capacity=32))
+    cm = ComponentModule()
+    cm.register(Guard)
+    cm.register(Berserk)
+    pm = PluginManager(app_name="test")
+    pm.register_plugin(Plugin("KernelPlugin", [k]))
+    pm.register_plugin(Plugin("LogicPlugin", [cm]))
+    pm.start()
+    return k, cm, pm
+
+
+def test_schema_attach_on_create_finish():
+    k, cm, pm = build_world()
+    g = k.create_object("NPC", {"HP": 10})
+    comps = cm.components_of(g)
+    # two registered prototypes attach; the unregistered name is skipped
+    assert [c.name for c in comps] == ["Guard", "Berserk"]
+    assert comps[0].enabled and not comps[1].enabled  # Enable flag from schema
+    assert all(c.has_init for c in comps)
+    # init then after_init, per component, in schema order
+    assert TraceComponent.log == [
+        ("Guard", "init", g),
+        ("Guard", "after_init", g),
+        ("Berserk", "init", g),
+        ("Berserk", "after_init", g),
+    ]
+
+
+def test_execute_runs_enabled_components_each_frame():
+    k, cm, pm = build_world()
+    a = k.create_object("NPC", {})
+    b = k.create_object("NPC", {})
+    TraceComponent.log = []
+    pm.run_once()
+    execs = [(n, g) for (n, what, g) in TraceComponent.log if what == "execute"]
+    # only enabled components run; per-object order preserved
+    assert execs == [("Guard", a), ("Guard", b)]
+    cm.set_enable(a, "Berserk", True)
+    cm.set_enable(b, "Guard", False)
+    TraceComponent.log = []
+    pm.run_once()
+    execs = [(n, g) for (n, what, g) in TraceComponent.log if what == "execute"]
+    assert execs == [("Guard", a), ("Berserk", a)]
+
+
+def test_detach_on_destroy_calls_before_shut():
+    k, cm, pm = build_world()
+    g = k.create_object("NPC", {})
+    assert cm.components_of(g)
+    TraceComponent.log = []
+    k.destroy_object(g)
+    shuts = [(n, gg) for (n, what, gg) in TraceComponent.log if what == "before_shut"]
+    assert shuts == [("Guard", g), ("Berserk", g)]
+    assert cm.components_of(g) == []
+    assert cm.find(g, "Guard") is None
+
+
+def test_manual_attach_and_find():
+    k, cm, pm = build_world()
+    g = k.create_object("IObject", {})
+    assert cm.components_of(g) == []  # no schema components on IObject
+    inst = cm.attach(g, "Guard")
+    assert inst is not None and cm.find(g, "Guard") is inst
+    assert cm.attach(g, "Nope") is None
+
+
+class RageDriver(ObjectComponent):
+    """Scripted-NPC behavior: divergent per-object host logic on top of the
+    batch device world (the 'host module vs batchable module' seam)."""
+
+    name = "RageDriver"
+
+    def execute(self):
+        rage = self.kernel.get_property(self.guid, "Rage")
+        if self.kernel.get_property(self.guid, "HP") < 5:
+            self.kernel.set_property(self.guid, "Rage", rage + 1)
+
+
+def test_scripted_component_drives_device_world():
+    k, cm, pm = build_world()
+    cm.register(RageDriver)
+    hurt = k.create_object("NPC", {"HP": 3})
+    fine = k.create_object("NPC", {"HP": 50})
+    for g in (hurt, fine):
+        cm.attach(g, "RageDriver")
+    for _ in range(4):
+        pm.run_once()
+    assert k.get_property(hurt, "Rage") == 4
+    assert k.get_property(fine, "Rage") == 0
+    # device state observed the host writes
+    cls = k.state.classes["NPC"]
+    col = k.store.spec("NPC").slot("Rage").col
+    assert int(np.asarray(cls.i32[:, col]).sum()) == 4

@@ -8,7 +8,7 @@ import time
 
 import pytest
 
-from noahgameframe_tpu.kernel import ActorModule, AsyncSqlModule, Component
+from noahgameframe_tpu.kernel import ActorComponent, ActorModule, AsyncSqlModule
 from noahgameframe_tpu.persist import SqlModule
 from noahgameframe_tpu.utils import LogLevel, LogModule, TickMetrics
 
@@ -18,7 +18,7 @@ from noahgameframe_tpu.utils import LogLevel, LogModule, TickMetrics
 
 def test_actor_offload_and_marshal_back():
     am = ActorModule(threads=2)
-    comp = Component()
+    comp = ActorComponent()
     comp.on(1, lambda _m, x: x * 2)
     aid = am.require_actor(comp)
     results = []
@@ -44,7 +44,7 @@ def test_actor_offload_and_marshal_back():
 def test_actor_message_ordering_per_mailbox():
     am = ActorModule(threads=4)
     seen = []
-    comp = Component()
+    comp = ActorComponent()
     comp.on_any(lambda _m, x: (time.sleep(0.001), seen.append(x))[1] or x)
     aid = am.require_actor(comp)
     for i in range(20):
@@ -59,7 +59,7 @@ def test_actor_message_ordering_per_mailbox():
 
 def test_actor_errors_are_collected_not_raised():
     am = ActorModule(threads=1)
-    comp = Component()
+    comp = ActorComponent()
     comp.on(1, lambda _m, _x: 1 / 0)
     aid = am.require_actor(comp)
     am.send_to_actor(aid, 1, None, lambda *a: None)

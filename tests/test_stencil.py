@@ -1,0 +1,260 @@
+"""Cell-table stencil engine: brute-force parity, overflow bounds,
+determinism, and combat-phase equivalence with an O(N^2) reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from noahgameframe_tpu.game import GameWorld, WorldConfig
+from noahgameframe_tpu.game.defines import PropertyGroup
+from noahgameframe_tpu.ops.stencil import (
+    auto_bucket,
+    build_cell_table,
+    pull,
+    stencil_fold,
+)
+
+
+def rand_pos(n, extent, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.uniform(0, extent, size=(n, 2)).astype(np.float32)
+
+
+def test_build_cell_table_places_all_and_counts_drops():
+    n = 400
+    pos = jnp.asarray(rand_pos(n, 80.0, seed=3))
+    active = jnp.ones(n, bool).at[::5].set(False)
+    feats = jnp.stack([pos[:, 0], pos[:, 1]], -1)
+    t = build_cell_table(pos, active, feats, 10.0, 8, bucket=32)
+    assert int(t.dropped) == 0
+    v = np.asarray(t.grid_view())
+    # every active entity occupies exactly one slot holding its features
+    occ = v[..., -1]
+    assert int(occ.sum()) == int(np.asarray(active).sum())
+    slot_of = np.asarray(t.slot_of)
+    dump = 8 * 8 * 32
+    act = np.asarray(active)
+    assert (slot_of[~act] == dump).all()
+    assert (slot_of[act] != dump).all()
+    assert len(set(slot_of[act].tolist())) == act.sum()  # unique slots
+    flat = np.asarray(t.payload)
+    px = np.asarray(pos[:, 0])
+    np.testing.assert_allclose(flat[slot_of[act], 0], px[act])
+
+
+def test_overflow_counted_and_isolated():
+    # 50 entities piled into one cell with bucket=8 -> 42 dropped
+    pos = jnp.zeros((50, 2)) + 5.0
+    feats = jnp.zeros((50, 0), jnp.float32)
+    t = build_cell_table(pos, jnp.ones(50, bool), feats, 10.0, 4, bucket=8)
+    assert int(t.dropped) == 42
+    v = np.asarray(t.grid_view())
+    assert v[..., -1].sum() == 8  # cell 0 full, nothing leaked
+
+
+def test_auto_bucket_keeps_overflow_tiny_at_benchmark_density():
+    """BASELINE configs 2-4 run ~6.4 entities/cell; the auto bucket must
+    keep silent drops below 0.1% (round-2 verdict item 4)."""
+    n = 50_000
+    extent = float(np.sqrt(n / 0.4))
+    cell = 4.0
+    width = int(extent / cell)
+    k = auto_bucket(n, width)
+    pos = jnp.asarray(rand_pos(n, extent, seed=7))
+    feats = jnp.zeros((n, 0), jnp.float32)
+    t = build_cell_table(pos, jnp.ones(n, bool), feats, cell, width, k)
+    assert int(t.dropped) <= n // 1000
+
+
+def test_pull_roundtrip_and_fill():
+    n = 100
+    pos = jnp.asarray(rand_pos(n, 40.0, seed=1))
+    active = jnp.ones(n, bool).at[7].set(False)
+    feats = jnp.stack([jnp.arange(n, dtype=jnp.float32)], -1)
+    t = build_cell_table(pos, active, feats, 10.0, 4, bucket=16)
+    v = t.grid_view()
+    got = pull(t, v[..., 0], fill=-5.0)
+    exp = np.where(np.asarray(active), np.arange(n, dtype=np.float32), -5.0)
+    np.testing.assert_allclose(np.asarray(got), exp)
+    # multi-column pull
+    got2 = pull(t, jnp.stack([v[..., 0], v[..., 0] * 2], -1), fill=(-1.0, -2.0))
+    assert np.asarray(got2)[7].tolist() == [-1.0, -2.0]
+
+
+def test_stencil_fold_neighbor_sum_matches_bruteforce():
+    n = 300
+    extent = 60.0
+    pos_np = rand_pos(n, extent, seed=5)
+    val_np = np.arange(1, n + 1, dtype=np.float32)
+    pos = jnp.asarray(pos_np)
+    feats = jnp.stack([pos[:, 0], pos[:, 1], jnp.asarray(val_np)], -1)
+    t = build_cell_table(pos, jnp.ones(n, bool), feats, 10.0, 6, bucket=32)
+    v = t.grid_view()
+    r2 = 8.0 * 8.0
+
+    def fold(acc, cand):
+        dx = v[..., 0][..., None] - cand[:, :, None, :, 0]
+        dy = v[..., 1][..., None] - cand[:, :, None, :, 1]
+        ok = (dx * dx + dy * dy <= r2) & (cand[:, :, None, :, 3] > 0)
+        # exclude self by feature value (vals are unique)
+        ok &= cand[:, :, None, :, 2] != v[..., 2][..., None]
+        return acc + jnp.sum(jnp.where(ok, cand[:, :, None, :, 2], 0.0), -1)
+
+    got = pull(t, stencil_fold(t, fold, jnp.zeros(v.shape[:3])), fill=0.0)
+    d = pos_np[:, None, :] - pos_np[None, :, :]
+    within = (d * d).sum(-1) <= 64.0
+    np.fill_diagonal(within, False)
+    exp = (within * val_np[None, :]).sum(1)
+    np.testing.assert_allclose(np.asarray(got), exp)
+
+
+def brute_combat(pos, hp, atk, deff, camp, key, attacking, alive, radius):
+    """O(N^2) reference of the AoE damage resolution semantics
+    (NFCSkillModule::OnUseSkill damage + LastAttacker,
+    /root/reference/NFServer/NFGameLogicPlugin/NFCSkillModule.cpp:74-160)."""
+    n = len(hp)
+    new_hp = hp.copy()
+    last = np.full(n, -1)
+    for i in range(n):
+        if not (alive[i] and hp[i] > 0):
+            continue
+        inc = 0
+        best_atk, best_row = -1, -1
+        for j in range(n):
+            if j == i or not attacking[j]:
+                continue
+            if camp[j] == camp[i] or key[j] != key[i]:
+                continue
+            d = pos[i] - pos[j]
+            if (d * d).sum() > radius * radius:
+                continue
+            inc += atk[j]
+            if atk[j] > best_atk:
+                best_atk, best_row = atk[j], j
+        if inc > 0:
+            dmg = max(max(inc - deff[i], 0), 1)
+            new_hp[i] = max(hp[i] - dmg, 0)
+            last[i] = best_row
+    return new_hp, last
+
+
+def test_combat_phase_matches_bruteforce():
+    """Full-phase parity on a dense little world: damage sums, defense
+    floor, camp/partition scoping, self-exclusion, LastAttacker choice."""
+    n = 150
+    rng = np.random.RandomState(11)
+    extent = 40.0
+    w = GameWorld(
+        WorldConfig(
+            npc_capacity=256,
+            extent=extent,
+            aoe_radius=5.0,
+            attack_period_s=1.0 / 30.0,  # everyone attacks every tick
+            movement=False,
+            regen=False,
+            middleware=False,
+        )
+    )
+    w.start()
+    w.scene.create_scene(1, width=extent)
+    k = w.kernel
+    pos = rng.uniform(0, extent, (n, 2)).astype(np.float32)
+    camps = rng.randint(0, 3, n)
+    groups = rng.randint(0, 2, n)
+    atks = rng.randint(0, 30, n)
+    defs = rng.randint(0, 6, n)
+    guids = []
+    for i in range(n):
+        g = k.create_object(
+            "NPC",
+            {
+                "Position": (float(pos[i, 0]), float(pos[i, 1]), 0.0),
+                "Camp": int(camps[i]),
+                "HP": 1000,
+            },
+            scene=1,
+            group=int(groups[i]),
+        )
+        w.properties.set_group_value(g, "ATK_VALUE", PropertyGroup.EFFECTVALUE, int(atks[i]))
+        w.properties.set_group_value(g, "DEF_VALUE", PropertyGroup.EFFECTVALUE, int(defs[i]))
+        guids.append(g)
+    w.combat.arm_all()
+    w.tick()  # stats recompute; attack timers armed for next tick
+    hp_before = np.asarray([k.get_property(g, "HP") for g in guids])
+    assert (hp_before == 1000).all()
+    w.tick()  # first exchange
+    spec = k.store.spec("NPC")
+    from noahgameframe_tpu.kernel.scene import MAX_GROUPS_PER_SCENE
+
+    keys = (np.ones(n) * MAX_GROUPS_PER_SCENE + groups).astype(np.int64)
+    exp_hp, exp_last = brute_combat(
+        pos, hp_before, atks, defs, camps, keys,
+        attacking=np.ones(n, bool), alive=np.ones(n, bool), radius=5.0,
+    )
+    got_hp = np.asarray([k.get_property(g, "HP") for g in guids])
+    np.testing.assert_array_equal(got_hp, exp_hp)
+    # LastAttacker: compare the strongest attacker's guid where hit
+    rows = {g: k.store.row_of(g)[1] for g in guids}
+    for i, g in enumerate(guids):
+        if exp_last[i] >= 0:
+            la = k.get_property(g, "LastAttacker")
+            exp_guid = guids[exp_last[i]]
+            # ties on atk value may legitimately resolve to a different
+            # equal-atk attacker; accept any attacker with the max atk
+            cand = [
+                j
+                for j in range(len(guids))
+                if atks[j] == atks[exp_last[i]]
+                and camps[j] != camps[i]
+                and keys[j] == keys[i]
+                and j != i
+                and ((pos[i] - pos[j]) ** 2).sum() <= 25.0
+            ]
+            assert la in {guids[j] for j in cand}, (i, la, exp_guid)
+
+
+def test_combat_phase_deterministic():
+    w1 = GameWorld(WorldConfig(npc_capacity=64, extent=32.0, movement=False,
+                               regen=False, middleware=False,
+                               attack_period_s=1.0 / 30.0))
+    w2 = GameWorld(WorldConfig(npc_capacity=64, extent=32.0, movement=False,
+                               regen=False, middleware=False,
+                               attack_period_s=1.0 / 30.0))
+    for w in (w1, w2):
+        w.start()
+        w.scene.create_scene(1, width=32.0)
+        w.seed_npcs(40, hp=200, atk=15)
+        for _ in range(10):
+            w.tick()
+    a = np.asarray(w1.kernel.state.classes["NPC"].i32)
+    b = np.asarray(w2.kernel.state.classes["NPC"].i32)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_combat_scene_scoped_at_large_scene_ids():
+    """Scene isolation must survive large scene ids (f32 columns: scene
+    and group compared separately, each exact below 2^24)."""
+    from noahgameframe_tpu.game import GameWorld, WorldConfig
+
+    w = GameWorld(
+        WorldConfig(
+            npc_capacity=16, extent=32.0, aoe_radius=5.0,
+            attack_period_s=1.0 / 30.0, movement=False, regen=False,
+            middleware=False,
+        )
+    )
+    w.start()
+    s1, s2 = 16384, 16385  # adjacent ids that collide under f32 packing
+    w.scene.create_scene(s1, width=32.0)
+    w.scene.create_scene(s2, width=32.0)
+    k = w.kernel
+    a = k.create_object("NPC", {"Position": (10.0, 10.0, 0.0), "Camp": 0, "HP": 50}, scene=s1)
+    b = k.create_object("NPC", {"Position": (11.0, 10.0, 0.0), "Camp": 1, "HP": 50}, scene=s2)
+    for g in (a, b):
+        w.properties.set_group_value(g, "ATK_VALUE", PropertyGroup.EFFECTVALUE, 40)
+        w.properties.set_group_value(g, "MAXHP", PropertyGroup.EFFECTVALUE, 50)
+    w.combat.arm_all()
+    for _ in range(5):
+        w.tick()
+    assert k.get_property(a, "HP") == 50
+    assert k.get_property(b, "HP") == 50

@@ -1,0 +1,263 @@
+"""Cross-validate the hand-rolled proto2 codec against protoc.
+
+The reference's clients speak protoc-generated code
+(/root/reference/NFComm/NFMessageDefine/*.proto, NFClient/Unity3D); our
+net/wire.py re-implements the wire format by hand.  This test compiles the
+REFERENCE .proto files with the real protoc and, for every message class
+net/wire.py declares, round-trips a fully-populated instance BOTH ways:
+
+    wire.py encode -> protoc parse   (field-by-field value equality)
+    protoc serialize -> wire.py decode (field-by-field value equality)
+    wire.py bytes == protoc bytes      (byte-identical encoding)
+
+Byte identity holds because proto2 serializes scalar fields in tag order
+and wire.py declares FIELDS in tag order.
+
+Two authoring bugs in the reference's NFMsgShare.proto (duplicate field
+`user_id` in ShareObjectUserData, duplicate message ReqSearchToShare) are
+patched in the COPY we hand to protoc — protoc refuses them outright, so
+the reference itself can never have compiled that file as-is.
+"""
+
+import shutil
+import struct
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+import noahgameframe_tpu.net.wire as wire
+from noahgameframe_tpu.net.wire import Message
+
+PROTO_SRC = Path("/root/reference/NFComm/NFMessageDefine")
+PROTO_FILES = [
+    "NFDefine.proto",
+    "NFMsgBase.proto",
+    "NFMsgShare.proto",
+    "NFMsgPreGame.proto",
+    "NFMsgMysql.proto",
+    "NFMsgURl.proto",
+    "NFFleetingDefine.proto",
+    "NFSLGDefine.proto",
+]
+PB_MODULES = [
+    "NFMsgBase_pb2",
+    "NFMsgShare_pb2",
+    "NFMsgPreGame_pb2",
+    "NFMsgMysql_pb2",
+    "NFMsgURl_pb2",
+]
+
+# wire.py messages with no reference counterpart (original extensions)
+OURS_ONLY = {"BatchPropertySync"}
+
+
+@pytest.fixture(scope="module")
+def pb(tmp_path_factory):
+    if shutil.which("protoc") is None or not PROTO_SRC.is_dir():
+        pytest.skip("protoc or reference protos unavailable")
+    out = tmp_path_factory.mktemp("nfpb")
+    for f in PROTO_FILES:
+        shutil.copy(PROTO_SRC / f, out / f)
+    share = (out / "NFMsgShare.proto").read_text()
+    share = share.replace(
+        "\trequired string\t\tuser_id \t= 2;",
+        "\trequired string\t\tuser_name \t= 2;",
+    )
+    i = share.find("message ReqSearchToShare")
+    j = share.find("message ReqSearchToShare", i + 1)
+    share = share[:j] + share[j:].replace(
+        "message ReqSearchToShare", "message ReqShareToStart", 1
+    )
+    (out / "NFMsgShare.proto").write_text(share)
+    r = subprocess.run(
+        ["protoc", "-I", str(out), "--python_out", str(out)] + PROTO_FILES,
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    sys.path.insert(0, str(out))
+    try:
+        mods = [__import__(m) for m in PB_MODULES]
+    finally:
+        sys.path.remove(str(out))
+    registry = {}
+    for m in mods:
+        for name in m.DESCRIPTOR.message_types_by_name:
+            registry.setdefault(name, getattr(m, name))
+    return registry
+
+
+def wire_classes():
+    return sorted(
+        (
+            c
+            for c in vars(wire).values()
+            if isinstance(c, type)
+            and issubclass(c, Message)
+            and c is not Message
+            and c.__name__ not in OURS_ONLY
+        ),
+        key=lambda c: c.__name__,
+    )
+
+
+class ValueGen:
+    """Deterministic per-field test values covering sign/size edges."""
+
+    def __init__(self):
+        self.n = 0
+
+    def value(self, ftype, pdesc, pb_registry):
+        self.n += 1
+        i = self.n
+        if isinstance(ftype, tuple):  # repeated: 3 items
+            return [self.value(ftype[1], pdesc, pb_registry) for _ in range(3)]
+        if isinstance(ftype, type) and issubclass(ftype, Message):
+            return self.message(ftype, pb_registry)
+        if ftype == "enum":
+            vals = pdesc.enum_type.values
+            return vals[i % len(vals)].number
+        if ftype in ("int32", "int64"):
+            return [7, -1, 0, 1 << 30, -(1 << 31)][i % 5]
+        if ftype == "uint64":
+            return [0, 9, (1 << 63) + 5][i % 3]
+        if ftype == "bool":
+            return bool(i % 2)
+        if ftype == "float":
+            # exactly representable in f32
+            return [0.0, 1.5, -2.25, 1024.125][i % 4]
+        if ftype == "double":
+            return [0.0, 3.141592653589793, -1e100][i % 3]
+        if ftype in ("bytes", "string"):
+            v = f"v{i}".encode()
+            return v if ftype == "bytes" else v.decode()
+        raise AssertionError(f"unhandled field type {ftype}")
+
+    def message(self, cls, pb_registry):
+        pcls = pb_registry[cls.__name__]
+        by_tag = {f.number: f for f in pcls.DESCRIPTOR.fields}
+        kw = {}
+        for tag, name, ftype, _ in cls.FIELDS:
+            kw[name] = self.value(ftype, by_tag[tag], pb_registry)
+        return cls(**kw)
+
+
+def norm(v):
+    if isinstance(v, str):
+        return v.encode()
+    if isinstance(v, float):
+        return struct.unpack("<d", struct.pack("<d", v))[0]
+    return v
+
+
+def assert_matches_pb(ours, pmsg):
+    """Field-by-field equality of a wire.py message and a protoc message."""
+    by_tag = {f.number: f for f in type(pmsg).DESCRIPTOR.fields}
+    for tag, name, ftype, _ in ours.FIELDS:
+        ov = getattr(ours, name)
+        pv = getattr(pmsg, by_tag[tag].name)
+        if isinstance(ftype, tuple):
+            assert len(ov) == len(pv), (type(ours).__name__, name)
+            for o, p in zip(ov, pv):
+                if isinstance(ftype[1], type) and issubclass(ftype[1], Message):
+                    assert_matches_pb(o, p)
+                elif ftype[1] == "float":
+                    assert abs(o - p) < 1e-6
+                else:
+                    assert norm(o) == norm(p), (type(ours).__name__, name)
+        elif isinstance(ftype, type) and issubclass(ftype, Message):
+            if ov is not None:
+                assert_matches_pb(ov, pv)
+        elif ftype == "float":
+            assert abs(ov - pv) < 1e-6, (type(ours).__name__, name)
+        else:
+            assert norm(ov) == norm(pv), (type(ours).__name__, name, ov, pv)
+
+
+def assert_same_fields(a, b):
+    assert type(a) is type(b)
+    for _, name, ftype, _ in a.FIELDS:
+        av, bv = getattr(a, name), getattr(b, name)
+        if isinstance(ftype, tuple):
+            assert len(av) == len(bv)
+            for x, y in zip(av, bv):
+                if isinstance(ftype[1], type) and issubclass(ftype[1], Message):
+                    assert_same_fields(x, y)
+                elif ftype[1] == "float":
+                    assert abs(x - y) < 1e-6
+                else:
+                    assert norm(x) == norm(y), (type(a).__name__, name)
+        elif isinstance(ftype, type) and issubclass(ftype, Message):
+            if av is None:
+                assert bv is None or not bv.encode()
+            else:
+                assert_same_fields(av, bv)
+        elif ftype == "float":
+            assert abs(av - bv) < 1e-6
+        else:
+            assert norm(av) == norm(bv), (type(a).__name__, name, av, bv)
+
+
+def test_every_wire_message_has_protoc_counterpart(pb):
+    missing = [c.__name__ for c in wire_classes() if c.__name__ not in pb]
+    assert missing == []
+
+
+def test_field_tags_and_wire_types_match_protoc(pb):
+    from google.protobuf.descriptor import FieldDescriptor as FD
+
+    wt_of = {
+        FD.TYPE_INT32: 0, FD.TYPE_INT64: 0, FD.TYPE_UINT32: 0,
+        FD.TYPE_UINT64: 0, FD.TYPE_BOOL: 0, FD.TYPE_ENUM: 0,
+        FD.TYPE_FLOAT: 5, FD.TYPE_FIXED32: 5, FD.TYPE_DOUBLE: 1,
+        FD.TYPE_FIXED64: 1, FD.TYPE_STRING: 2, FD.TYPE_BYTES: 2,
+        FD.TYPE_MESSAGE: 2,
+    }
+    for c in wire_classes():
+        pdesc = pb[c.__name__].DESCRIPTOR
+        by_tag = {f.number: f for f in pdesc.fields}
+        for tag, name, ftype, _ in c.FIELDS:
+            assert tag in by_tag, (c.__name__, name)
+            pwt = wt_of[by_tag[tag].type]
+            if isinstance(ftype, tuple):
+                ftype = ftype[1]
+            if isinstance(ftype, type):
+                owt = 2
+            else:
+                owt = wire._WIRE_TYPE[ftype]
+            assert owt == pwt, (c.__name__, name, tag)
+
+
+def test_roundtrip_every_message_both_directions(pb):
+    gen = ValueGen()
+    for c in wire_classes():
+        ours = gen.message(c, pb)
+        our_bytes = ours.encode()
+        pmsg = pb[c.__name__]()
+        pmsg.ParseFromString(our_bytes)  # protoc accepts our bytes
+        assert_matches_pb(ours, pmsg)
+        p_bytes = pmsg.SerializeToString()
+        assert our_bytes == p_bytes, f"{c.__name__}: encoding not byte-identical"
+        back = c.decode(p_bytes)  # we accept protoc bytes
+        assert_same_fields(ours, back)
+
+
+def test_record_sync_messages_with_vector_lists(pb):
+    """The round-2 record-sync additions specifically (verdict item 5):
+    ObjectRecordSwap and RecordAddRowStruct's vector2/3 lists."""
+    gen = ValueGen()
+    for name in (
+        "ObjectRecordSwap",
+        "RecordAddRowStruct",
+        "ObjectRecordAddRow",
+        "ObjectRecordRemove",
+        "ObjectRecordVector2",
+        "ObjectRecordVector3",
+    ):
+        c = getattr(wire, name)
+        ours = gen.message(c, pb)
+        pmsg = pb[name]()
+        pmsg.ParseFromString(ours.encode())
+        assert ours.encode() == pmsg.SerializeToString()
