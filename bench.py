@@ -263,6 +263,109 @@ def run_bench(args) -> dict:
     }
 
 
+LADDER = (1_000_000, 500_000, 250_000, 100_000)
+
+
+def _served_probe() -> dict:
+    """One served-path measurement (100k entities, 500 sessions) in a
+    subprocess; non-fatal on failure."""
+    cmd = [
+        sys.executable, "-u", __file__,
+        "--entities", "100000", "--ticks", "30",
+        "--served", "--sessions", "500", "--platform", "tpu",
+    ]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=1800.0)
+    except subprocess.TimeoutExpired:
+        return {"error": "served probe timeout"}
+    for ln in reversed((r.stdout or "").strip().splitlines()):
+        if ln.startswith("{"):
+            try:
+                p = json.loads(ln)
+            except json.JSONDecodeError:
+                break
+            return {
+                "value": p.get("value"),
+                "unit": p.get("unit"),
+                "error": p.get("error"),
+                **{
+                    k: p.get("detail", {}).get(k)
+                    for k in ("entities", "sessions", "frame_ms_p50",
+                              "frame_ms_p99", "sync_msgs", "sync_bytes")
+                },
+            }
+    return {"error": f"served probe rc={r.returncode}"}
+
+
+def _run_ladder(probe_note, serve_args) -> None:
+    """Driver-default path: try the flagship 1M config, halving on failure
+    (round-2: a TPU worker crash at 1M burned the round's artifact).  Each
+    rung runs in a SUBPROCESS so a crashed/poisoned TPU client can't take
+    the parent — the parent always emits one JSON line."""
+    attempts = []
+    last_error = None
+    for n in LADDER:
+        cmd = [
+            sys.executable, "-u", __file__,
+            "--entities", str(n), "--ticks", "90", "--platform", "tpu",
+        ] + serve_args
+        try:
+            r = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=2400.0
+            )
+        except subprocess.TimeoutExpired:
+            attempts.append({"entities": n, "outcome": "timeout"})
+            last_error = f"rung {n}: timeout"
+            continue
+        line = None
+        for ln in reversed((r.stdout or "").strip().splitlines()):
+            if ln.startswith("{"):
+                line = ln
+                break
+        if line is None:
+            tail = (r.stderr or "").strip().splitlines()[-3:]
+            attempts.append(
+                {"entities": n, "outcome": f"rc={r.returncode}", "tail": tail}
+            )
+            last_error = f"rung {n}: no output (rc={r.returncode})"
+            continue
+        try:
+            payload = json.loads(line)
+        except json.JSONDecodeError:
+            # a crash mid-print can leave a truncated '{' line — treat it
+            # like a failed rung, never kill the parent emitter
+            attempts.append({"entities": n, "outcome": "bad json"})
+            last_error = f"rung {n}: unparseable output"
+            continue
+        if "error" in payload:
+            attempts.append(
+                {"entities": n, "outcome": "error", "error": payload["error"]}
+            )
+            last_error = payload["error"]
+            continue
+        if attempts:
+            payload.setdefault("detail", {})["ladder_fallbacks"] = attempts
+        if probe_note:
+            payload["detail"]["accelerator_probe_note"] = probe_note
+        if "--served" not in serve_args:
+            # capture the SERVED path too (tick + diff flush + fan-out to
+            # 500 sessions at 100k) so the round's artifact carries both
+            # numbers (round-2 weak #6)
+            payload.setdefault("detail", {})["served"] = _served_probe()
+        _emit(payload)
+        return
+    _emit(
+        {
+            "metric": "entities_ticked_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "entity-ticks/s",
+            "vs_baseline": 0.0,
+            "error": last_error or "every ladder rung failed",
+            "detail": {"ladder_fallbacks": attempts, "probe": probe_note},
+        }
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     # entities/ticks default to None so a CPU fallback can tell "driver
@@ -303,6 +406,12 @@ def main() -> None:
                 # CPU can't push the 1M config through the timed region
                 # in reasonable wall-clock
                 args.entities, args.ticks = 100_000, 30
+        elif not pinned:
+            serve = ["--served", "--sessions", str(args.sessions)] if args.served else []
+            if args.no_combat:
+                serve.append("--no-combat")
+            _run_ladder(note, serve)
+            return
     # platform == "tpu": let the default (axon) backend initialise in-process
     if args.entities is None:
         args.entities = 1_000_000

@@ -96,6 +96,7 @@ class GameClient:
         self.player_ident: Optional[Ident] = None  # proxy-assigned client id
         self.player_guid: Optional[Ident] = None  # game-side avatar guid
         self.entered = False
+        self.last_enter_code: Optional[int] = None  # refusal visibility
         # the world mirror
         self.objects: Dict[_IdentKey, MirrorObject] = {}
         self.chat_log: List[Tuple[str, str]] = []
@@ -260,6 +261,7 @@ class GameClient:
 
     def _on_enter_game(self, base: MsgBase) -> None:
         ack = AckEventResult.decode(base.msg_data)
+        self.last_enter_code = int(ack.event_code)
         if int(ack.event_code) == int(EventCode.ENTER_GAME_SUCCESS):
             self.entered = True
             self.player_guid = ack.event_object

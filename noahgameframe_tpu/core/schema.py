@@ -335,9 +335,14 @@ def _parse_record(elem: ET.Element) -> RecordDef:
     )
 
 
-def load_class_xml(path: Path, name: str, parent: Optional[str], instance_path: str = "") -> ClassDef:
-    """Parse one per-class XML (Propertys/Records/Components sections)."""
-    root = ET.parse(str(path)).getroot()
+def load_class_xml(path: Path, name: str, parent: Optional[str], instance_path: str = "",
+                   cipher_key=None) -> ClassDef:
+    """Parse one per-class XML (Propertys/Records/Components sections).
+    RC4-ciphered files (core/crypto.py NFRC4 convention; reference myrc4)
+    decrypt transparently when `cipher_key` is given."""
+    from .crypto import read_config_bytes
+
+    root = ET.fromstring(read_config_bytes(path, cipher_key))
     props = [_parse_property(p) for p in root.findall("./Propertys/Property")]
     recs = [_parse_record(r) for r in root.findall("./Records/Record")]
     comps = [
@@ -359,7 +364,8 @@ def load_class_xml(path: Path, name: str, parent: Optional[str], instance_path: 
     )
 
 
-def load_logic_class_xml(logic_class_path: Path, data_root: Optional[Path] = None) -> ClassRegistry:
+def load_logic_class_xml(logic_class_path: Path, data_root: Optional[Path] = None,
+                         cipher_key=None) -> ClassRegistry:
     """Load a reference-format LogicClass.xml class tree.
 
     `Path`/`InstancePath` attributes are resolved relative to `data_root`
@@ -378,14 +384,16 @@ def load_logic_class_xml(logic_class_path: Path, data_root: Optional[Path] = Non
         inst = elem.get("InstancePath", "")
         cls_path = data_root / rel if rel else None
         if cls_path is not None and cls_path.exists():
-            cls = load_class_xml(cls_path, name, parent, inst)
+            cls = load_class_xml(cls_path, name, parent, inst, cipher_key=cipher_key)
         else:
             cls = ClassDef(name=name, parent=parent, instance_path=inst)
         registry.define(cls)
         for child in elem.findall("Class"):
             walk(child, name)
 
-    root = ET.parse(str(logic_class_path)).getroot()
+    from .crypto import read_config_bytes
+
+    root = ET.fromstring(read_config_bytes(logic_class_path, cipher_key))
     for top in root.findall("Class"):
         walk(top, None)
     return registry

@@ -16,6 +16,7 @@ Time is injected (``now``) so tests can drive the FSM deterministically.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time as _time
 from typing import Callable, Dict, List, Optional
 
@@ -29,10 +30,20 @@ EventHandler = Callable[[int, int], None]  # (conn_id, event_kind)
 
 
 class _Dispatch:
+    """msgID -> handler fan-out with per-message fault isolation.
+
+    A handler that raises (malformed body failing proto decode, capacity
+    errors mid-handler, plain bugs) must never kill the server pump: the
+    reference logs the packet and keeps serving
+    (NFINetModule::OnReceiveNetPack, NFINetModule.h:473-520).  Each
+    handler call is isolated; failures are logged and counted."""
+
     def __init__(self) -> None:
         self._handlers: Dict[int, List[ReceiveHandler]] = {}
         self._default: List[ReceiveHandler] = []
         self._events: List[EventHandler] = []
+        self._log = logging.getLogger("nf.net.dispatch")
+        self.dropped_msgs = 0  # observability: handler faults survived
 
     def on(self, msg_id: int, fn: ReceiveHandler) -> None:
         self._handlers.setdefault(int(msg_id), []).append(fn)
@@ -44,19 +55,36 @@ class _Dispatch:
     def on_socket_event(self, fn: EventHandler) -> None:
         self._events.append(fn)
 
+    def _safe(self, fn, conn_id: int, msg_id: int, body: bytes) -> None:
+        try:
+            fn(conn_id, msg_id, body)
+        except Exception:  # noqa: BLE001 — isolate the serving edge
+            self.dropped_msgs += 1
+            self._log.exception(
+                "handler failed: conn=%d msg_id=%d len=%d (dropped)",
+                conn_id, msg_id, len(body),
+            )
+
     def feed(self, events: List[NetEvent]) -> None:
         for ev in events:
             if ev.kind == EV_MSG:
                 fns = self._handlers.get(ev.msg_id)
                 if fns:
                     for fn in fns:
-                        fn(ev.conn_id, ev.msg_id, ev.body)
+                        self._safe(fn, ev.conn_id, ev.msg_id, ev.body)
                 else:
                     for fn in self._default:
-                        fn(ev.conn_id, ev.msg_id, ev.body)
+                        self._safe(fn, ev.conn_id, ev.msg_id, ev.body)
             else:
                 for fn in self._events:
-                    fn(ev.conn_id, ev.kind)
+                    try:
+                        fn(ev.conn_id, ev.kind)
+                    except Exception:  # noqa: BLE001
+                        self.dropped_msgs += 1
+                        self._log.exception(
+                            "socket-event handler failed: conn=%d kind=%d",
+                            ev.conn_id, ev.kind,
+                        )
 
 
 class NetServerModule:

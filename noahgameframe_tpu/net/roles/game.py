@@ -352,6 +352,19 @@ class GameRole(ServerRole):
         if sess.guid is not None:
             self._despawn(sess)  # re-entry replaces the old avatar
         name = req.name.decode("utf-8", "replace")
+        store = self.kernel.store
+        if store.live_count("Player") >= store.capacity("Player"):
+            # world full: refuse gracefully BEFORE allocating, so no row
+            # leaks and the pump keeps serving — the reference answers
+            # with an event-result code on every enter-game failure path.
+            # Other create failures propagate to the dispatch isolation
+            # layer (logged + message dropped).
+            self._send_to_session(
+                sess,
+                MsgID.ACK_ENTER_GAME,
+                AckEventResult(event_code=int(EventCode.CHARACTER_NUMOUT)),
+            )
+            return
         guid = self.kernel.create_object(
             "Player",
             {"Name": name, "Account": sess.account, "GameID": self.config.server_id},

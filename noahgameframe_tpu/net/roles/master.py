@@ -164,8 +164,23 @@ class MasterRole(ServerRole):
             "servers": out,
         }
 
-    def _index_page(self, _path: str, _params: Dict[str, str]) -> str:
-        """Tiny built-in dashboard (Tool/NF_Web_Monitor equivalent)."""
+    def _index_page(self, _path: str, _params: Dict[str, str]):
+        """Dashboard at "/": serves the standalone monitor page
+        (tools/web_monitor/index.html, the Tool/NF_Web_Monitor
+        equivalent — a static page polling /json) and falls back to a
+        server-rendered table when the file is missing."""
+        from pathlib import Path
+
+        page = (
+            Path(__file__).resolve().parents[3]
+            / "tools" / "web_monitor" / "index.html"
+        )
+        if page.is_file():
+            return (200, "text/html", page.read_bytes())
+        return self._fallback_page()
+
+    def _fallback_page(self) -> str:
+        """Server-rendered table (no-JS fallback)."""
         rows = []
         for group, servers in self.servers_status()["servers"].items():
             for s in servers:

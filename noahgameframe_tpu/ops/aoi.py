@@ -140,12 +140,13 @@ def neighbor_counts(
     n = pos.shape[0]
     f32 = jnp.float32
     part = (
-        partition.astype(jnp.int64)
+        partition.astype(jnp.int32)
         if partition is not None
-        else jnp.zeros((n,), jnp.int64)
+        else jnp.zeros((n,), jnp.int32)
     )
     # split the partition key into two f32-exact halves (each < 2^24) so
-    # packed (scene, group) keys up to 2^36 compare exactly
+    # any int32 key compares exactly (int64 keys would silently truncate
+    # under JAX's default x64-disabled config — keep the domain honest)
     part_hi = (part >> 12).astype(f32)
     part_lo = (part & 0xFFF).astype(f32)
     feats = jnp.stack(

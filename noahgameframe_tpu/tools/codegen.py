@@ -339,6 +339,75 @@ def emit_name_constants(registry: ClassRegistry) -> str:
     return out.getvalue()
 
 
+_CS_KEYWORDS = {
+    "abstract", "as", "base", "bool", "break", "byte", "case", "catch",
+    "char", "checked", "class", "const", "continue", "decimal", "default",
+    "delegate", "do", "double", "else", "enum", "event", "explicit",
+    "extern", "false", "finally", "fixed", "float", "for", "foreach",
+    "goto", "if", "implicit", "in", "int", "interface", "internal", "is",
+    "lock", "long", "namespace", "new", "null", "object", "operator",
+    "out", "override", "params", "private", "protected", "public",
+    "readonly", "ref", "return", "sbyte", "sealed", "short", "sizeof",
+    "stackalloc", "static", "string", "struct", "switch", "this", "throw",
+    "true", "try", "typeof", "uint", "ulong", "unchecked", "unsafe",
+    "ushort", "using", "virtual", "void", "volatile", "while",
+}
+
+
+def _cs_ident(name: str, used: Optional[set] = None) -> str:
+    """C#-safe identifier; with `used`, also unique within that scope
+    (distinct schema names like 'a-b' vs 'a_b' both sanitize to 'a_b' —
+    emitting both would fail C# compilation)."""
+    ident = "".join(ch if ch.isalnum() or ch == "_" else "_" for ch in name)
+    if not ident or ident[0].isdigit() or ident in _CS_KEYWORDS:
+        ident = "_" + ident
+    if used is not None:
+        base, n = ident, 2
+        while ident in used:
+            ident = f"{base}_{n}"
+            n += 1
+        used.add(ident)
+    return ident
+
+
+def emit_name_constants_cs(registry: ClassRegistry) -> str:
+    """C# source text for the Unity client SDK: per-class name constants
+    and record column indices in an `NFrame` namespace, matching the
+    reference codegen's .cs emitter
+    (NFTools/NFFileProcess FileProcess.h:38-72 emits NFProtocolDefine.cs
+    alongside the .hpp/.java bindings)."""
+    out = io.StringIO()
+    out.write("// GENERATED name constants - do not edit by hand.\n")
+    out.write("// Regenerate with scripts/codegen.py --cs.\n\n")
+    out.write("namespace NFrame\n{\n")
+    top_used: set = set()
+    for name in registry.names():
+        flat = registry._flatten(name)
+        cls = _cs_ident(name, top_used)
+        used = {"ThisName"}
+        out.write(f"    public static class {cls}\n    {{\n")
+        out.write(f'        public const string ThisName = "{name}";\n')
+        for p in flat.properties:
+            out.write(
+                f'        public const string {_cs_ident(p.name, used)} = "{p.name}";\n'
+            )
+        for r in flat.records:
+            rid = _cs_ident(f"R_{r.name}", used)
+            rec_used = {"ThisName", "MaxRows"}
+            out.write(f"\n        public static class {rid}\n        {{\n")
+            out.write(f'            public const string ThisName = "{r.name}";\n')
+            out.write(f"            public const int MaxRows = {r.max_rows};\n")
+            for i, c in enumerate(r.cols):
+                out.write(
+                    f"            public const int "
+                    f"{_cs_ident(f'Col_{c.tag}', rec_used)} = {i};\n"
+                )
+            out.write("        }\n")
+        out.write("    }\n\n")
+    out.write("}\n")
+    return out.getvalue()
+
+
 # =====================================================================
 # The pipeline (GenerateConfigXML.sh equivalent)
 # =====================================================================
@@ -386,7 +455,9 @@ class CodegenPipeline:
 
         consts = self.out_dir / "proto_define.py"
         consts.write_text(emit_name_constants(registry))
-        report["constants"] = [str(consts)]
+        cs = self.out_dir / "NFProtocolDefine.cs"
+        cs.write_text(emit_name_constants_cs(registry))
+        report["constants"] = [str(consts), str(cs)]
 
         from ..persist.sql import emit_ddl
 

@@ -15,6 +15,8 @@ row by (role, id) and derives its upstream targets from the others
 from __future__ import annotations
 
 import argparse
+import faulthandler
+import os
 import sys
 import time
 from pathlib import Path
@@ -49,7 +51,18 @@ def main() -> int:
                     help="master only: status HTTP port")
     ap.add_argument("--tick-sleep", type=float, default=0.001,
                     help="main-loop sleep (reference: 1 ms)")
+    ap.add_argument("--crash-log-dir", type=Path, default=Path("crashlogs"),
+                    help="where crash tracebacks are written")
     args = ap.parse_args()
+
+    # crash capture: the reference installs a minidump handler around its
+    # main loop (NFPluginLoader.cpp:42-69); the Python equivalent dumps
+    # every thread's traceback to a per-process crash file on SIGSEGV/
+    # SIGFPE/SIGABRT/SIGBUS and on hard faults in native extensions
+    args.crash_log_dir.mkdir(parents=True, exist_ok=True)
+    crash_path = args.crash_log_dir / f"{args.role}_{args.id}_{os.getpid()}.crash"
+    crash_file = open(crash_path, "w")  # noqa: SIM115 — must outlive main
+    faulthandler.enable(file=crash_file, all_threads=True)
 
     cls, stype, upstream_type = ROLE_CLASSES[args.role]
     rows = load_server_xml(args.server_xml)

@@ -168,3 +168,23 @@ def test_blank_type_cell_defaults_to_int(tmp_path):
         "[class],name=C\n[property]\nName,Type,Public\nFoo,,1\n")
     cdef = load_class_csv(tmp_path / "C.csv")
     assert cdef.properties[0].type.name == "INT"
+
+
+def test_cs_constants_emitter(tmp_path):
+    src, out = tmp_path / "src", tmp_path / "out"
+    src.mkdir()
+    (src / "Hero.csv").write_text(
+        "[class],name=Hero\n[property]\nName,Type,Public\nHP,int,1\n"
+        "class,string,1\n"
+        "[record:Bag],rows=4,public=1\nTag,Type\nItem,string\nCount,int\n")
+    report = CodegenPipeline(src, out).run()
+    cs_files = [p for p in report["constants"] if p.endswith(".cs")]
+    assert cs_files
+    text = (out / "NFProtocolDefine.cs").read_text()
+    assert "namespace NFrame" in text
+    assert 'public const string HP = "HP";' in text
+    # reserved word escaped, original string preserved
+    assert 'public const string _class = "class";' in text
+    assert "public static class R_Bag" in text
+    assert "public const int Col_Count = 1" in text
+    assert "public const int MaxRows = 4" in text

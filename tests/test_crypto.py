@@ -1,0 +1,60 @@
+"""RC4 ciphered-config support (reference myrc4.{h,cpp} parity)."""
+
+import pytest
+
+from noahgameframe_tpu.core.crypto import (
+    MAGIC,
+    decrypt_config,
+    encrypt_config,
+    rc4,
+    read_config_bytes,
+)
+from noahgameframe_tpu.core.schema import load_logic_class_xml
+
+
+def test_rc4_known_vector():
+    # RFC 6229-style check: RC4("Key", "Plaintext") -> BBF316E8D940AF0AD3
+    out = rc4(b"Key", b"Plaintext")
+    assert out.hex() == "bbf316e8d940af0ad3"
+
+
+def test_rc4_symmetry_and_magic():
+    data = b"<xml>config</xml>" * 10
+    enc = encrypt_config(data, "s3cret")
+    assert enc.startswith(MAGIC) and enc != data
+    assert decrypt_config(enc, "s3cret") == data
+    # plaintext passes through, wrong usage fails loudly
+    assert decrypt_config(data, "s3cret") == data
+    assert decrypt_config(data, None) == data
+    with pytest.raises(ValueError):
+        decrypt_config(enc, None)
+
+
+def test_ciphered_logic_class_loads(tmp_path):
+    (tmp_path / "NFDataCfg" / "Struct" / "Class").mkdir(parents=True)
+    logic = tmp_path / "NFDataCfg" / "Struct" / "LogicClass.xml"
+    cls = tmp_path / "NFDataCfg" / "Struct" / "Class" / "Thing.xml"
+    cls_xml = (
+        "<XML><Propertys>"
+        '<Property Id="HP" Type="int" Public="1"/>'
+        "</Propertys><Records/><Components/></XML>"
+    )
+    logic_xml = (
+        '<XML><Class Id="Thing" Path="NFDataCfg/Struct/Class/Thing.xml"/></XML>'
+    )
+    logic.write_bytes(encrypt_config(logic_xml.encode(), "k1"))
+    cls.write_bytes(encrypt_config(cls_xml.encode(), "k1"))
+    reg = load_logic_class_xml(logic, cipher_key="k1")
+    assert "Thing" in reg.names()
+    flat = reg._flatten("Thing")
+    assert [p.name for p in flat.properties] == ["HP"]
+    # the plaintext loader path still works for unciphered trees
+    logic.write_text(logic_xml)
+    cls.write_text(cls_xml)
+    assert "Thing" in load_logic_class_xml(logic).names()
+
+
+def test_read_config_bytes(tmp_path):
+    p = tmp_path / "x.xml"
+    p.write_bytes(encrypt_config(b"<a/>", b"\x01\x02"))
+    assert read_config_bytes(p, b"\x01\x02") == b"<a/>"

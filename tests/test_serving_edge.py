@@ -1,0 +1,111 @@
+"""Serving-edge robustness: malformed frames must not kill a role process
+(reference logs-and-drops, NFINetModule.h:473-520), and a full world must
+answer enter-game with a refusal instead of an exception."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from noahgameframe_tpu.game.world import GameWorld, WorldConfig
+from noahgameframe_tpu.net.defines import EventCode, MsgID
+from noahgameframe_tpu.net.roles import LocalCluster
+from noahgameframe_tpu.net.transport import create_client
+
+from test_roles import drive_client, full_login
+
+
+@pytest.fixture()
+def small_cluster():
+    gw = GameWorld(
+        WorldConfig(combat=False, movement=False, regen=False,
+                    npc_capacity=64, player_capacity=2)
+    ).start()
+    c = LocalCluster(http_port=0, game_world=gw)
+    c.start(timeout=20.0)
+    yield c
+    c.shut()
+
+
+def _pump(cluster, client, seconds=0.3):
+    end = time.time() + seconds
+    while time.time() < end:
+        cluster.execute()
+        client.poll()
+        time.sleep(0.005)
+
+
+GARBAGE = [
+    b"",
+    b"\xff" * 64,
+    b"\x80\x80\x80\x80\x80\x80\x80\x80\x80\x80\x80",  # endless varint
+    b"\x0a\xff\xff\xff\xff\x0f",  # length-delimited field longer than body
+    os.urandom(256),
+]
+
+
+def test_garbage_bodies_do_not_kill_roles(small_cluster):
+    cluster = small_cluster
+    for role in (cluster.game, cluster.proxy, cluster.world, cluster.login):
+        port = role.server.port
+        cli = create_client("127.0.0.1", port, backend="py")
+        cli.connect()
+        end = time.time() + 2.0
+        while not cli.connected and time.time() < end:
+            cluster.execute()
+            cli.poll()
+            time.sleep(0.005)
+        assert cli.connected
+        # garbage on registered handler ids (login/connect-key/role
+        # CRUD/enter/move) and on unknown ids
+        for msg_id in (0, 1, 101, 120, 132, 134, 150, 1230, 9999):
+            for body in GARBAGE:
+                cli.send_msg(msg_id, body)
+        _pump(cluster, cli, 0.5)
+        cli.disconnect()
+    # the pump survived; a real client can still complete the full pipeline
+    c = full_login(cluster, "survivor", "Survivor")
+    assert c.entered
+    dropped = sum(
+        r.server.dispatch.dropped_msgs
+        for r in (cluster.game, cluster.proxy, cluster.world, cluster.login)
+    )
+    assert dropped > 0  # at least one garbage body really hit a decoder
+
+
+def test_world_full_enter_game_refused(small_cluster):
+    cluster = small_cluster
+    # capacity 2: two avatars fit, the third must be refused gracefully
+    a = full_login(cluster, "p1", "One")
+    b = full_login(cluster, "p2", "Two")
+    assert a.entered and b.entered
+
+    c = None
+    from noahgameframe_tpu.client import GameClient
+
+    c = GameClient("p3")
+    c.connect("127.0.0.1", cluster.login.config.port)
+    drive_client(cluster, c, lambda: c.connected)
+    c.login()
+    drive_client(cluster, c, lambda: c.logged_in)
+    c.request_world_list()
+    drive_client(cluster, c, lambda: c.worlds)
+    c.connect_world(c.worlds[0].server_id)
+    drive_client(cluster, c, lambda: c.world_grant is not None)
+    c.connect_proxy()
+    drive_client(cluster, c, lambda: c.connected)
+    c.verify_key()
+    drive_client(cluster, c, lambda: c.key_verified)
+    c.select_server(cluster.game.config.server_id)
+    drive_client(cluster, c, lambda: c.server_selected)
+    c.create_role("Three")
+    drive_client(cluster, c, lambda: c.roles)
+    c.enter_game("Three")
+    drive_client(cluster, c, lambda: c.last_enter_code is not None, timeout=5.0)
+    # the role process is alive and refused: no avatar was created
+    assert not c.entered
+    assert c.last_enter_code == int(EventCode.CHARACTER_NUMOUT)
+    players = cluster.game.scene.objects_in_group(1, 1, "Player")
+    assert len(players) == 2
