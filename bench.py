@@ -266,13 +266,14 @@ def run_bench(args) -> dict:
 LADDER = (1_000_000, 500_000, 250_000, 100_000)
 
 
-def _served_probe() -> dict:
+def _served_probe(extra_args=()) -> dict:
     """One served-path measurement (100k entities, 500 sessions) in a
     subprocess; non-fatal on failure."""
     cmd = [
         sys.executable, "-u", __file__,
         "--entities", "100000", "--ticks", "30",
         "--served", "--sessions", "500", "--platform", "tpu",
+        *extra_args,
     ]
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=1800.0)
@@ -350,8 +351,10 @@ def _run_ladder(probe_note, serve_args) -> None:
         if "--served" not in serve_args:
             # capture the SERVED path too (tick + diff flush + fan-out to
             # 500 sessions at 100k) so the round's artifact carries both
-            # numbers (round-2 weak #6)
-            payload.setdefault("detail", {})["served"] = _served_probe()
+            # numbers (round-2 weak #6) — same combat config as the rung
+            payload.setdefault("detail", {})["served"] = _served_probe(
+                [a for a in serve_args if a == "--no-combat"]
+            )
         _emit(payload)
         return
     _emit(

@@ -164,6 +164,9 @@ def _http(status: int, ctype: str, body: bytes) -> bytes:
         f"HTTP/1.0 {status} {_STATUS.get(status, 'Unknown')}\r\n"
         f"Content-Type: {ctype}\r\n"
         f"Content-Length: {len(body)}\r\n"
+        # a read-only status API; allow the web-monitor page to poll it
+        # when opened from disk or another host
+        "Access-Control-Allow-Origin: *\r\n"
         "Connection: close\r\n\r\n"
     )
     return head.encode("latin-1") + body

@@ -378,13 +378,14 @@ def emit_name_constants_cs(registry: ClassRegistry) -> str:
     alongside the .hpp/.java bindings)."""
     out = io.StringIO()
     out.write("// GENERATED name constants - do not edit by hand.\n")
-    out.write("// Regenerate with scripts/codegen.py --cs.\n\n")
+    out.write("// Regenerate with scripts/codegen.py.\n\n")
     out.write("namespace NFrame\n{\n")
     top_used: set = set()
     for name in registry.names():
         flat = registry._flatten(name)
         cls = _cs_ident(name, top_used)
-        used = {"ThisName"}
+        # a member named like its enclosing type is a C# error (CS0542)
+        used = {cls, "ThisName"}
         out.write(f"    public static class {cls}\n    {{\n")
         out.write(f'        public const string ThisName = "{name}";\n')
         for p in flat.properties:

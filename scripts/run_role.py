@@ -15,6 +15,7 @@ row by (role, id) and derives its upstream targets from the others
 from __future__ import annotations
 
 import argparse
+import atexit
 import faulthandler
 import os
 import sys
@@ -63,6 +64,17 @@ def main() -> int:
     crash_path = args.crash_log_dir / f"{args.role}_{args.id}_{os.getpid()}.crash"
     crash_file = open(crash_path, "w")  # noqa: SIM115 — must outlive main
     faulthandler.enable(file=crash_file, all_threads=True)
+
+    def _tidy_crash_file() -> None:
+        # keep only real fault dumps; a clean exit leaves the file empty
+        try:
+            crash_file.flush()
+            if crash_path.stat().st_size == 0:
+                crash_path.unlink()
+        except OSError:
+            pass
+
+    atexit.register(_tidy_crash_file)
 
     cls, stype, upstream_type = ROLE_CLASSES[args.role]
     rows = load_server_xml(args.server_xml)

@@ -109,3 +109,94 @@ def test_shard_rows_by_cell():
     cell = np.asarray([3, 1, 3, 0, 1, 2])
     order = shard_rows_by_cell(6, 2, cell)
     assert (np.sort(cell[order]) == cell[order]).all()
+
+
+def test_sharded_large_world_uneven_aliveness():
+    """Round-2 verdict item 9: a >=64k-capacity sharded world with
+    aliveness concentrated on a few shards (non-uniform row occupancy)
+    must tick correctly and preserve combat/diff semantics."""
+    w = GameWorld(
+        WorldConfig(
+            npc_capacity=65536,
+            player_capacity=64,
+            extent=256.0,
+            attack_period_s=1.0 / 30.0,
+            middleware=False,
+        )
+    )
+    w.start()
+    w.scene.create_scene(1, width=256.0)
+    # 12k alive entities: rows are allocated densely from 0, so with
+    # capacity 64k over 8 shards only the first ~1.5 shards hold live
+    # rows — the worst-case imbalance for per-shard work
+    w.seed_npcs(12_000, camps=2)
+    sk = ShardedKernel(w.kernel, n_devices=N_DEV)
+    sk.place()
+    sk.run_device(35)
+    hp = np.asarray(w.kernel.store.column(w.kernel.state, "NPC", "HP"))
+    alive = np.asarray(w.kernel.state.classes["NPC"].alive)
+    assert alive.sum() == 12_000
+    assert (hp[alive] < 100).any()  # combat still lands
+    # dead region stayed dead
+    assert not alive[12_000:].any()
+
+
+def test_sharded_combat_parity_across_shards():
+    """Cross-shard combat parity: entities intermingled at the same
+    coordinates but placed on DIFFERENT shards must resolve identical
+    damage to the single-device run (the collective path carries the
+    cell-table across shard boundaries)."""
+
+    def build():
+        w = GameWorld(
+            WorldConfig(
+                npc_capacity=512,
+                player_capacity=64,
+                extent=64.0,
+                attack_period_s=1.0 / 30.0,
+                movement=False,
+                regen=False,
+                middleware=False,
+            )
+        )
+        w.start()
+        w.scene.create_scene(1, width=64.0)
+        # interleaved camps at close quarters; row i and row i+1 land on
+        # different shards once the 512 rows split 64-per-shard
+        rng = np.random.RandomState(5)
+        pos = rng.uniform(0, 64.0, (400, 2)).astype(np.float32)
+        k = w.kernel
+        values = {
+            "SceneID": [1] * 400,
+            "GroupID": [0] * 400,
+            "Position": [(float(x), float(y), 0.0) for x, y in pos],
+            "HP": [300] * 400,
+            "Camp": [i % 2 for i in range(400)],
+        }
+        k.state, guids, rows = k.store.create_many(k.state, "NPC", 400, values=values)
+        from noahgameframe_tpu.game.defines import COMM_PROPERTY_RECORD, PropertyGroup
+
+        k.state = k.store.record_write_rows(
+            k.state, "NPC", rows, COMM_PROPERTY_RECORD,
+            int(PropertyGroup.EFFECTVALUE),
+            {"MAXHP": [300] * 400, "ATK_VALUE": [9] * 400, "DEF_VALUE": [2] * 400},
+        )
+        w.combat.arm_all()
+        return w
+
+    ref = build()
+    for _ in range(8):
+        ref.tick()
+
+    w = build()
+    sk = ShardedKernel(w.kernel, n_devices=N_DEV)
+    sk.place()
+    for _ in range(8):
+        sk.tick()
+
+    a = np.asarray(w.kernel.store.column(w.kernel.state, "NPC", "HP"))
+    b = np.asarray(ref.kernel.store.column(ref.kernel.state, "NPC", "HP"))
+    np.testing.assert_array_equal(a, b)
+    la = np.asarray(w.kernel.store.column(w.kernel.state, "NPC", "LastAttacker"))
+    lb = np.asarray(ref.kernel.store.column(ref.kernel.state, "NPC", "LastAttacker"))
+    np.testing.assert_array_equal(la, lb)
