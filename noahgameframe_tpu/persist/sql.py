@@ -12,6 +12,7 @@ tables.
 
 from __future__ import annotations
 
+import dataclasses
 import sqlite3
 import threading
 from pathlib import Path
@@ -130,6 +131,160 @@ class SqlModule:
 
     def close(self) -> None:
         self._conn.close()
+
+    def ping(self) -> bool:
+        """Connection health probe (the driver manager's keepalive)."""
+        try:
+            with self._lock:
+                self._conn.execute("SELECT 1")
+            return True
+        except sqlite3.Error:
+            return False
+
+
+# ---------------------------------------------------------------------------
+# Driver manager: multi-server registration + keepalive/reconnect FSM
+# ---------------------------------------------------------------------------
+
+DRV_DISCONNECTED, DRV_CONNECTED = 0, 1
+
+
+@dataclasses.dataclass
+class SqlServerConfig:
+    """One database server row (reference AddMysqlServer signature:
+    serverID, dns/ip, port, dbName, user, password, reconnect time/count —
+    NFCMysqlModule.h:32-40).  The sqlite engine only uses db_name as the
+    database path; the endpoint/credential fields ride along so a real
+    MySQL driver slots behind the same registration call."""
+
+    server_id: int
+    db_name: str = ":memory:"
+    ip: str = ""
+    port: int = 0
+    user: str = ""
+    password: str = ""
+    reconnect_time: float = 10.0
+    reconnect_count: int = -1  # -1 = retry forever
+
+
+class SqlDriver:
+    """One managed connection with a reconnect state machine."""
+
+    def __init__(self, config: SqlServerConfig) -> None:
+        self.config = config
+        self.state = DRV_DISCONNECTED
+        self.module: Optional[SqlModule] = None
+        self.reconnects_left = config.reconnect_count
+        self._next_attempt = 0.0
+
+    def connect(self, now: float = 0.0) -> bool:
+        try:
+            self.module = SqlModule(self.config.db_name)
+            self.state = DRV_CONNECTED
+            return True
+        except sqlite3.Error:
+            self.state = DRV_DISCONNECTED
+            self._next_attempt = now + self.config.reconnect_time
+            return False
+
+    def keep_alive(self, now: float) -> bool:
+        """Ping; on failure enter DISCONNECTED and retry after
+        reconnect_time, at most reconnect_count times (reference driver
+        keepalive semantics).  Returns current health."""
+        if self.state == DRV_CONNECTED:
+            if self.module is not None and self.module.ping():
+                return True
+            self.state = DRV_DISCONNECTED
+            self._next_attempt = now + self.config.reconnect_time
+            return False
+        if now >= self._next_attempt and self.reconnects_left != 0:
+            if self.reconnects_left > 0:
+                self.reconnects_left -= 1
+            return self.connect(now)
+        return False
+
+
+class SqlDriverManager:
+    """Multiple named servers behind one Updata/Query/... facade.
+
+    Mirrors the reference's driver manager: register servers by id,
+    operations route to a healthy driver (an explicit server_id or the
+    first connected one), and `execute(now)` runs the 10 s keepalive
+    sweep from the main loop."""
+
+    def __init__(self, keepalive_seconds: float = 10.0) -> None:
+        self.keepalive_seconds = float(keepalive_seconds)
+        self._drivers: Dict[int, SqlDriver] = {}
+        self._last_sweep = 0.0
+
+    def add_server(self, config: SqlServerConfig, now: float = 0.0) -> SqlDriver:
+        drv = SqlDriver(config)
+        drv.connect(now)
+        self._drivers[config.server_id] = drv
+        return drv
+
+    def driver(self, server_id: Optional[int] = None) -> Optional[SqlDriver]:
+        if server_id is not None:
+            d = self._drivers.get(server_id)
+            return d if d is not None and d.state == DRV_CONNECTED else None
+        for d in self._drivers.values():
+            if d.state == DRV_CONNECTED:
+                return d
+        return None
+
+    def execute(self, now: float) -> None:
+        if now - self._last_sweep < self.keepalive_seconds:
+            return
+        self._last_sweep = now
+        for d in self._drivers.values():
+            d.keep_alive(now)
+
+    # -- facade (reference-shaped, returns False/None on any failure) ----
+    def _call(self, server_id: Optional[int], op, fail):
+        """Route to a healthy driver; a connection that died since the
+        last keepalive sweep returns the failure value (and flips the
+        driver to DISCONNECTED) instead of leaking sqlite3.Error into the
+        caller's main-loop tick."""
+        d = self.driver(server_id)
+        if d is None or d.module is None:
+            return fail
+        try:
+            return op(d.module)
+        except sqlite3.Error:
+            d.state = DRV_DISCONNECTED
+            d._next_attempt = self._last_sweep + d.config.reconnect_time
+            return fail
+
+    def updata(self, table, key, fields, values, server_id=None) -> bool:
+        return self._call(
+            server_id, lambda m: m.updata(table, key, fields, values), False
+        )
+
+    def query(self, table, key, fields, server_id=None):
+        return self._call(
+            server_id, lambda m: m.query(table, key, fields), None
+        )
+
+    def select(self, table, key, server_id=None):
+        return self._call(server_id, lambda m: m.select(table, key), None)
+
+    def delete(self, table, key, server_id=None) -> bool:
+        return self._call(server_id, lambda m: m.delete(table, key), False)
+
+    def exists(self, table, key, server_id=None) -> bool:
+        return self._call(server_id, lambda m: m.exists(table, key), False)
+
+    def keys(self, table, like="%", server_id=None):
+        return self._call(server_id, lambda m: m.keys(table, like), [])
+
+    def close(self) -> None:
+        """Terminal shutdown: drivers close AND lose their reconnect
+        budget, so a stray execute() after close cannot reopen files."""
+        for d in self._drivers.values():
+            if d.module is not None:
+                d.module.close()
+            d.state = DRV_DISCONNECTED
+            d.reconnects_left = 0
 
 
 def emit_ddl(registry, class_names: Sequence[str]) -> str:

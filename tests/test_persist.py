@@ -315,3 +315,88 @@ def test_record_object_and_vector_cells_roundtrip():
     at = store.record_get(state, fresh, "Refs", 0, "At")
     assert tuple(round(x, 3) for x in at) == (1.0, 2.0, 3.0)
     assert store.record_get(state, fresh, "Refs", 0, "N") == 5
+
+
+def test_sql_driver_manager_keepalive_reconnect(tmp_path):
+    """Multi-server registration + keepalive/reconnect FSM (reference
+    NFCMysqlDriverManager semantics: NFCMysqlModule.h:32-40)."""
+    from noahgameframe_tpu.persist.sql import (
+        DRV_CONNECTED,
+        DRV_DISCONNECTED,
+        SqlDriverManager,
+        SqlServerConfig,
+    )
+
+    mgr = SqlDriverManager(keepalive_seconds=10.0)
+    a = mgr.add_server(SqlServerConfig(server_id=1, db_name=str(tmp_path / "a.db"),
+                                       reconnect_time=10.0))
+    b = mgr.add_server(SqlServerConfig(server_id=2, db_name=str(tmp_path / "b.db")))
+    assert a.state == DRV_CONNECTED and b.state == DRV_CONNECTED
+
+    # routing: explicit server id hits its own database
+    assert mgr.updata("Player", "k1", ["Name"], ["Ann"], server_id=1)
+    assert mgr.updata("Player", "k2", ["Name"], ["Bob"], server_id=2)
+    assert mgr.query("Player", "k1", ["Name"], server_id=1) == ["Ann"]
+    assert mgr.query("Player", "k1", ["Name"], server_id=2) is None
+
+    # simulate a dead connection on server 1
+    a.module.close()
+    mgr.execute(now=100.0)  # keepalive sweep detects the dead link
+    assert a.state == DRV_DISCONNECTED
+    # operations fail over to the surviving driver / explicit id refuses
+    assert mgr.query("Player", "k1", ["Name"], server_id=1) is None
+    assert mgr.updata("Player", "k3", ["Name"], ["Cyn"]) is True  # routed to b
+
+    # not yet: backoff window (10 s) has not elapsed at the next sweep
+    mgr.execute(now=105.0)
+    assert a.state == DRV_DISCONNECTED
+    # after the backoff the driver reconnects and data is durable on disk
+    mgr.execute(now=111.0)
+    assert a.state == DRV_CONNECTED
+    assert mgr.query("Player", "k1", ["Name"], server_id=1) == ["Ann"]
+
+
+def test_sql_driver_reconnect_count_bounds_retries(tmp_path):
+    from noahgameframe_tpu.persist.sql import (
+        DRV_CONNECTED,
+        DRV_DISCONNECTED,
+        SqlDriver,
+        SqlServerConfig,
+    )
+
+    d = SqlDriver(SqlServerConfig(server_id=1, db_name=str(tmp_path / "c.db"),
+                                  reconnect_time=5.0, reconnect_count=1))
+    d.connect(0.0)
+    assert d.state == DRV_CONNECTED
+    d.module.close()
+    assert d.keep_alive(10.0) is False  # detects death, arms backoff
+    assert d.keep_alive(16.0) is True   # one allowed reconnect succeeds
+    d.module.close()
+    assert d.keep_alive(30.0) is False
+    # budget exhausted: stays down forever
+    assert d.keep_alive(300.0) is False
+    assert d.state == DRV_DISCONNECTED
+
+
+def test_sql_driver_manager_close_is_terminal_and_faults_dont_leak(tmp_path):
+    from noahgameframe_tpu.persist.sql import (
+        DRV_CONNECTED,
+        SqlDriverManager,
+        SqlServerConfig,
+    )
+
+    mgr = SqlDriverManager(keepalive_seconds=10.0)
+    a = mgr.add_server(SqlServerConfig(server_id=1, db_name=str(tmp_path / "t.db")))
+    assert mgr.updata("T", "k", ["f"], ["v"])
+    # a connection that dies between keepalive sweeps returns the failure
+    # value instead of raising, and flips the driver down
+    a.module.close()
+    assert mgr.query("T", "k", ["f"], server_id=1) is None
+    assert a.state != DRV_CONNECTED
+    # close() is terminal: a later sweep must NOT reopen the database
+    mgr.execute(now=50.0)  # allowed: reconnects (budget -1)
+    assert a.state == DRV_CONNECTED
+    mgr.close()
+    mgr.execute(now=500.0)
+    assert a.state != DRV_CONNECTED
+    assert mgr.query("T", "k", ["f"]) is None

@@ -28,6 +28,7 @@ from pathlib import Path
 import pytest
 
 import noahgameframe_tpu.net.wire as wire
+import noahgameframe_tpu.net.wire_families as wire_families
 from noahgameframe_tpu.net.wire import Message
 
 PROTO_SRC = Path("/root/reference/NFComm/NFMessageDefine")
@@ -47,6 +48,8 @@ PB_MODULES = [
     "NFMsgPreGame_pb2",
     "NFMsgMysql_pb2",
     "NFMsgURl_pb2",
+    "NFSLGDefine_pb2",
+    "NFFleetingDefine_pb2",
 ]
 
 # wire.py messages with no reference counterpart (original extensions)
@@ -83,24 +86,32 @@ def pb(tmp_path_factory):
     finally:
         sys.path.remove(str(out))
     registry = {}
+
+    def add(name, cls):
+        registry.setdefault(name, cls)
+        # nested messages (NFFleetingDefine event tracks) register under
+        # their simple nested name, matching wire_families' flat classes
+        for nested in cls.DESCRIPTOR.nested_types:
+            add(nested.name, getattr(cls, nested.name))
+
     for m in mods:
         for name in m.DESCRIPTOR.message_types_by_name:
-            registry.setdefault(name, getattr(m, name))
+            add(name, getattr(m, name))
     return registry
 
 
 def wire_classes():
-    return sorted(
-        (
-            c
-            for c in vars(wire).values()
-            if isinstance(c, type)
-            and issubclass(c, Message)
-            and c is not Message
-            and c.__name__ not in OURS_ONLY
-        ),
-        key=lambda c: c.__name__,
-    )
+    seen = {}
+    for mod in (wire, wire_families):
+        for c in vars(mod).values():
+            if (
+                isinstance(c, type)
+                and issubclass(c, Message)
+                and c is not Message
+                and c.__name__ not in OURS_ONLY
+            ):
+                seen.setdefault(c.__name__, c)
+    return sorted(seen.values(), key=lambda c: c.__name__)
 
 
 class ValueGen:
