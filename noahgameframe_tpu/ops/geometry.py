@@ -1,0 +1,130 @@
+"""Batched geometry helpers (vectors, rays, spheres, planes, AABBs).
+
+Reference parity: NFComm/NFCore ships NFVector2/3, NFMath and the
+NFLine/NFPlane/NFRay/NFSphere/NFBox headers (SURVEY §2.1 — unused by any
+reference module, but part of the core surface).  Rebuilt TPU-first:
+every helper is a pure jnp function over [..., 2|3] coordinate arrays,
+so one call tests N rays against N spheres on device — usable inside
+jit'd module phases (line-of-sight gates, projectile sweeps) instead of
+one-object-at-a-time host math.
+
+Conventions: rays are (origin, direction) with unnormalized directions
+allowed; "t" parameters are in units of the direction vector; misses
+return t = inf so downstream `jnp.where(hit, ...)` stays branch-free.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_EPS = 1e-12
+INF = jnp.inf
+
+
+# ------------------------------------------------------------------ vectors
+def dot(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(a * b, axis=-1)
+
+
+def length(v: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sqrt(jnp.maximum(dot(v, v), 0.0))
+
+
+def normalize(v: jnp.ndarray) -> jnp.ndarray:
+    """Zero vectors normalize to zero (no NaNs under jit)."""
+    n = length(v)
+    return jnp.where(n[..., None] > _EPS, v / jnp.maximum(n, _EPS)[..., None], 0.0)
+
+
+def distance(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return length(a - b)
+
+
+def lerp(a: jnp.ndarray, b: jnp.ndarray, t) -> jnp.ndarray:
+    t = jnp.asarray(t)
+    return a + (b - a) * t[..., None]
+
+
+def cross(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.cross(a, b)
+
+
+# -------------------------------------------------------------------- rays
+def ray_point(origin: jnp.ndarray, direction: jnp.ndarray, t) -> jnp.ndarray:
+    return origin + direction * jnp.asarray(t)[..., None]
+
+
+def ray_sphere(
+    origin: jnp.ndarray,
+    direction: jnp.ndarray,
+    center: jnp.ndarray,
+    radius,
+) -> jnp.ndarray:
+    """First intersection t >= 0 of ray(s) with sphere(s); inf on miss.
+    Rays starting inside hit at the exit point."""
+    radius = jnp.asarray(radius)
+    oc = origin - center
+    a = dot(direction, direction)
+    b = 2.0 * dot(oc, direction)
+    c = dot(oc, oc) - radius * radius
+    disc = b * b - 4.0 * a * c
+    sq = jnp.sqrt(jnp.maximum(disc, 0.0))
+    a2 = jnp.maximum(2.0 * a, _EPS)
+    t0 = (-b - sq) / a2
+    t1 = (-b + sq) / a2
+    t = jnp.where(t0 >= 0.0, t0, t1)
+    # a degenerate (zero-direction) ray hits only if it STARTS inside
+    ok = jnp.where(a > _EPS, (disc >= 0.0) & (t >= 0.0), c <= 0.0)
+    return jnp.where(ok, jnp.where(a > _EPS, t, 0.0), INF)
+
+
+def ray_plane(
+    origin: jnp.ndarray,
+    direction: jnp.ndarray,
+    normal: jnp.ndarray,
+    plane_d,
+) -> jnp.ndarray:
+    """t of ray against plane dot(n, x) + d = 0; inf when parallel or
+    behind the origin."""
+    plane_d = jnp.asarray(plane_d)
+    denom = dot(direction, normal)
+    t = -(dot(origin, normal) + plane_d) / jnp.where(
+        jnp.abs(denom) > _EPS, denom, _EPS
+    )
+    return jnp.where((jnp.abs(denom) > _EPS) & (t >= 0.0), t, INF)
+
+
+def ray_aabb(
+    origin: jnp.ndarray,
+    direction: jnp.ndarray,
+    box_min: jnp.ndarray,
+    box_max: jnp.ndarray,
+) -> jnp.ndarray:
+    """Slab test: entry t (0 when starting inside); inf on miss."""
+    inv = 1.0 / jnp.where(jnp.abs(direction) > _EPS, direction, _EPS)
+    t1 = (box_min - origin) * inv
+    t2 = (box_max - origin) * inv
+    t_near = jnp.max(jnp.minimum(t1, t2), axis=-1)
+    t_far = jnp.min(jnp.maximum(t1, t2), axis=-1)
+    hit = (t_far >= jnp.maximum(t_near, 0.0))
+    return jnp.where(hit, jnp.maximum(t_near, 0.0), INF)
+
+
+# ----------------------------------------------------------------- queries
+def point_in_aabb(p: jnp.ndarray, box_min: jnp.ndarray, box_max: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all((p >= box_min) & (p <= box_max), axis=-1)
+
+
+def sphere_overlap(ca: jnp.ndarray, ra, cb: jnp.ndarray, rb) -> jnp.ndarray:
+    ra, rb = jnp.asarray(ra), jnp.asarray(rb)
+    d2 = dot(ca - cb, ca - cb)
+    r = ra + rb
+    return d2 <= r * r
+
+
+def segment_point_distance(a: jnp.ndarray, b: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """Distance from point(s) p to segment(s) ab."""
+    ab = b - a
+    t = dot(p - a, ab) / jnp.maximum(dot(ab, ab), _EPS)
+    t = jnp.clip(t, 0.0, 1.0)
+    return length(p - (a + ab * t[..., None]))

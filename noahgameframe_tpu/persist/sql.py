@@ -177,7 +177,18 @@ class SqlDriver:
         self.reconnects_left = config.reconnect_count
         self._next_attempt = 0.0
 
+    def _drop_module(self) -> None:
+        """Close the dead connection before discarding it — reconnect
+        cycles must not leak file handles / lock-holding transactions."""
+        if self.module is not None:
+            try:
+                self.module.close()
+            except sqlite3.Error:
+                pass
+            self.module = None
+
     def connect(self, now: float = 0.0) -> bool:
+        self._drop_module()
         try:
             self.module = SqlModule(self.config.db_name)
             self.state = DRV_CONNECTED
@@ -187,6 +198,11 @@ class SqlDriver:
             self._next_attempt = now + self.config.reconnect_time
             return False
 
+    def mark_dead(self, now: float) -> None:
+        self._drop_module()
+        self.state = DRV_DISCONNECTED
+        self._next_attempt = now + self.config.reconnect_time
+
     def keep_alive(self, now: float) -> bool:
         """Ping; on failure enter DISCONNECTED and retry after
         reconnect_time, at most reconnect_count times (reference driver
@@ -194,8 +210,7 @@ class SqlDriver:
         if self.state == DRV_CONNECTED:
             if self.module is not None and self.module.ping():
                 return True
-            self.state = DRV_DISCONNECTED
-            self._next_attempt = now + self.config.reconnect_time
+            self.mark_dead(now)
             return False
         if now >= self._next_attempt and self.reconnects_left != 0:
             if self.reconnects_left > 0:
@@ -216,11 +231,16 @@ class SqlDriverManager:
         self.keepalive_seconds = float(keepalive_seconds)
         self._drivers: Dict[int, SqlDriver] = {}
         self._last_sweep = 0.0
+        self._now = 0.0  # latest injected time (advanced by execute())
 
     def add_server(self, config: SqlServerConfig, now: float = 0.0) -> SqlDriver:
+        old = self._drivers.get(config.server_id)
+        if old is not None:
+            old._drop_module()  # re-registration must not leak the old link
         drv = SqlDriver(config)
         drv.connect(now)
         self._drivers[config.server_id] = drv
+        self._now = max(self._now, now)
         return drv
 
     def driver(self, server_id: Optional[int] = None) -> Optional[SqlDriver]:
@@ -233,6 +253,7 @@ class SqlDriverManager:
         return None
 
     def execute(self, now: float) -> None:
+        self._now = max(self._now, now)
         if now - self._last_sweep < self.keepalive_seconds:
             return
         self._last_sweep = now
@@ -241,18 +262,22 @@ class SqlDriverManager:
 
     # -- facade (reference-shaped, returns False/None on any failure) ----
     def _call(self, server_id: Optional[int], op, fail):
-        """Route to a healthy driver; a connection that died since the
-        last keepalive sweep returns the failure value (and flips the
-        driver to DISCONNECTED) instead of leaking sqlite3.Error into the
-        caller's main-loop tick."""
+        """Route to a healthy driver; failures return the `fail` value
+        instead of leaking sqlite3.Error into the caller's main-loop
+        tick.  A statement/data error on a healthy connection (bad bind
+        value, constraint) does NOT kill the driver — only a failed
+        re-ping marks it dead, arming the backoff from the latest
+        injected time."""
         d = self.driver(server_id)
         if d is None or d.module is None:
             return fail
         try:
             return op(d.module)
-        except sqlite3.Error:
-            d.state = DRV_DISCONNECTED
-            d._next_attempt = self._last_sweep + d.config.reconnect_time
+        except (sqlite3.Error, ValueError):
+            # ValueError: identifier validation (_q) — a caller bug, not
+            # a connection fault; either way the tick must not die
+            if not d.module.ping():
+                d.mark_dead(self._now)
             return fail
 
     def updata(self, table, key, fields, values, server_id=None) -> bool:
@@ -281,8 +306,7 @@ class SqlDriverManager:
         """Terminal shutdown: drivers close AND lose their reconnect
         budget, so a stray execute() after close cannot reopen files."""
         for d in self._drivers.values():
-            if d.module is not None:
-                d.module.close()
+            d._drop_module()
             d.state = DRV_DISCONNECTED
             d.reconnects_left = 0
 

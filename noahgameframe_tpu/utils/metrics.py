@@ -90,6 +90,56 @@ class TickMetrics(Module):
         return json.dumps(self.snapshot())
 
 
+class MemoryCensus(Module):
+    """Live-object census per kind — the reference's NFMemoryCounter
+    (global class-name -> live-instance-count map inherited by core
+    types, NFMemoryCounter.cpp:13-27) rebuilt for the SoA world: entity
+    rows per class from the store allocators, plus host-side registries
+    (actor mailboxes, per-object components, net sessions) registered as
+    probes.  XLA owns device memory, so device bytes are reported from
+    live device buffers when available."""
+
+    name = "MemoryCensus"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._probes: Dict[str, object] = {}
+
+    def register_probe(self, kind: str, fn) -> None:
+        """fn() -> int live count for a host-side object kind."""
+        self._probes[kind] = fn
+
+    def census(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        if self.kernel is not None and self.kernel.store is not None:
+            for c in self.kernel.store.class_order:
+                out[f"entity:{c}"] = self.kernel.store.live_count(c)
+        for kind, fn in self._probes.items():
+            try:
+                out[kind] = int(fn())
+            except Exception:  # noqa: BLE001 — census must never throw
+                out[kind] = -1
+        return out
+
+    def device_bytes(self) -> int:
+        """Bytes held by this process's live device arrays (best effort)."""
+        try:
+            import jax
+
+            return sum(
+                buf.nbytes
+                for buf in jax.live_arrays()
+                if hasattr(buf, "nbytes")
+            )
+        except Exception:  # noqa: BLE001
+            return -1
+
+    def json_line(self) -> str:
+        out = dict(self.census())
+        out["device_bytes"] = self.device_bytes()
+        return json.dumps(out)
+
+
 @contextlib.contextmanager
 def profiler_trace(log_dir: str):
     """JAX profiler capture around a block — open the result with

@@ -1,0 +1,91 @@
+"""Batched geometry ops (reference NFVector/NFRay/NFSphere/NFBox family)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from noahgameframe_tpu.ops import geometry as g
+from noahgameframe_tpu.utils.metrics import MemoryCensus
+
+
+def test_vector_basics():
+    v = jnp.asarray([[3.0, 4.0, 0.0], [0.0, 0.0, 0.0]])
+    np.testing.assert_allclose(np.asarray(g.length(v)), [5.0, 0.0])
+    n = np.asarray(g.normalize(v))
+    np.testing.assert_allclose(n[0], [0.6, 0.8, 0.0], atol=1e-6)
+    np.testing.assert_allclose(n[1], 0.0)  # zero-safe
+    np.testing.assert_allclose(
+        np.asarray(g.lerp(v[:1], v[:1] * 2, 0.5))[0], [4.5, 6.0, 0.0]
+    )
+
+
+def test_ray_sphere_batch():
+    origins = jnp.asarray([[0.0, 0.0, 0.0]] * 3)
+    dirs = jnp.asarray([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0], [-1.0, 0.0, 0.0]])
+    center = jnp.asarray([[5.0, 0.0, 0.0]] * 3)
+    t = np.asarray(g.ray_sphere(origins, dirs, center, 1.0))
+    assert abs(t[0] - 4.0) < 1e-5  # straight hit
+    assert t[1] == np.inf  # perpendicular miss
+    assert t[2] == np.inf  # behind
+    # starting inside exits through the far side
+    t_in = float(g.ray_sphere(jnp.zeros(3), jnp.asarray([1.0, 0, 0]),
+                              jnp.zeros(3), 2.0))
+    assert abs(t_in - 2.0) < 1e-5
+
+
+def test_ray_plane_and_aabb():
+    t = float(g.ray_plane(jnp.asarray([0.0, 5.0, 0.0]),
+                          jnp.asarray([0.0, -1.0, 0.0]),
+                          jnp.asarray([0.0, 1.0, 0.0]), 0.0))
+    assert abs(t - 5.0) < 1e-6
+    assert float(g.ray_plane(jnp.asarray([0.0, 5.0, 0.0]),
+                             jnp.asarray([1.0, 0.0, 0.0]),
+                             jnp.asarray([0.0, 1.0, 0.0]), 0.0)) == np.inf
+    t = float(g.ray_aabb(jnp.asarray([-5.0, 0.5, 0.5]),
+                         jnp.asarray([1.0, 0.0, 0.0]),
+                         jnp.zeros(3), jnp.ones(3)))
+    assert abs(t - 5.0) < 1e-6
+    # starting inside -> 0
+    assert float(g.ray_aabb(jnp.asarray([0.5, 0.5, 0.5]),
+                            jnp.asarray([1.0, 0.0, 0.0]),
+                            jnp.zeros(3), jnp.ones(3))) == 0.0
+
+
+def test_queries_jit():
+    f = jax.jit(lambda p: g.point_in_aabb(p, jnp.zeros(3), jnp.ones(3)))
+    assert bool(f(jnp.asarray([0.5, 0.5, 0.5])))
+    assert not bool(f(jnp.asarray([1.5, 0.5, 0.5])))
+    assert bool(g.sphere_overlap(jnp.zeros(3), 1.0, jnp.asarray([1.5, 0, 0]), 1.0))
+    d = float(g.segment_point_distance(jnp.zeros(2), jnp.asarray([10.0, 0.0]),
+                                       jnp.asarray([5.0, 3.0])))
+    assert abs(d - 3.0) < 1e-6
+
+
+def test_memory_census():
+    from noahgameframe_tpu.game import GameWorld, WorldConfig
+
+    w = GameWorld(WorldConfig(npc_capacity=16, combat=False, movement=False,
+                              regen=False, middleware=False))
+    w.start()
+    w.scene.create_scene(1)
+    mc = MemoryCensus()
+    mc.kernel = w.kernel
+    w.kernel.create_object("NPC", {}, scene=1)
+    w.kernel.create_object("NPC", {}, scene=1)
+    mc.register_probe("sessions", lambda: 3)
+    mc.register_probe("broken", lambda: 1 / 0)
+    c = mc.census()
+    assert c["entity:NPC"] == 2
+    assert c["sessions"] == 3
+    assert c["broken"] == -1  # a probe fault never kills the census
+    import json
+
+    line = json.loads(mc.json_line())
+    assert "device_bytes" in line
+
+
+def test_ray_sphere_zero_direction():
+    # stationary sweep: hits only when starting inside the sphere
+    z = jnp.zeros(3)
+    assert float(g.ray_sphere(jnp.asarray([9.0, 0, 0]), z, z, 1.0)) == np.inf
+    assert float(g.ray_sphere(jnp.asarray([0.5, 0, 0]), z, z, 1.0)) == 0.0

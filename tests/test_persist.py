@@ -400,3 +400,22 @@ def test_sql_driver_manager_close_is_terminal_and_faults_dont_leak(tmp_path):
     mgr.execute(now=500.0)
     assert a.state != DRV_CONNECTED
     assert mgr.query("T", "k", ["f"]) is None
+
+
+def test_sql_data_error_does_not_kill_driver(tmp_path):
+    """A bad bind value on a healthy connection returns the failure value
+    but leaves the driver CONNECTED (no false-positive reconnect that
+    would re-point :memory: databases at fresh empty ones)."""
+    from noahgameframe_tpu.persist.sql import (
+        DRV_CONNECTED,
+        SqlDriverManager,
+        SqlServerConfig,
+    )
+
+    mgr = SqlDriverManager()
+    a = mgr.add_server(SqlServerConfig(server_id=1))  # :memory:
+    assert mgr.updata("T", "k", ["f"], ["v"])
+    assert mgr.updata("T", "k2", ["f"], [object()]) is False  # unbindable
+    assert a.state == DRV_CONNECTED
+    # previously-written data survives (no silent fresh database)
+    assert mgr.query("T", "k", ["f"]) == ["v"]
