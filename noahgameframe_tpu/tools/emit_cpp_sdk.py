@@ -116,17 +116,24 @@ inline void frame(std::string& out, uint16_t msg_id, const std::string& body) {
     out.push_back(char(total >> 8)); out.push_back(char(total));
     out.append(body);
 }
-inline bool unframe(const std::string& buf, size_t& off, uint16_t& msg_id,
-                    std::string& body) {
-    if (buf.size() - off < 6) return false;
+// Max frame size mirrors the server codec (net/framing.py): a header
+// announcing more is a protocol error, not a reason to buffer gigabytes.
+const uint32_t kMaxFrameSize = 64u * 1024u * 1024u;
+
+enum UnframeResult { UNFRAME_NEED_MORE = 0, UNFRAME_OK = 1, UNFRAME_ERROR = -1 };
+
+inline UnframeResult unframe(const std::string& buf, size_t& off,
+                             uint16_t& msg_id, std::string& body) {
+    if (buf.size() - off < 6) return UNFRAME_NEED_MORE;
     const uint8_t* d = reinterpret_cast<const uint8_t*>(buf.data()) + off;
     msg_id = uint16_t(d[0]) << 8 | d[1];
     uint32_t total = uint32_t(d[2]) << 24 | uint32_t(d[3]) << 16 |
                      uint32_t(d[4]) << 8 | d[5];
-    if (total < 6 || buf.size() - off < total) return false;
+    if (total < 6 || total > kMaxFrameSize) return UNFRAME_ERROR;
+    if (buf.size() - off < total) return UNFRAME_NEED_MORE;
     body.assign(buf, off + 6, total - 6);
     off += total;
-    return true;
+    return UNFRAME_OK;
 }
 """
 
@@ -222,8 +229,18 @@ def emit_header() -> str:
         w("    }\n")
         w("    std::string Encode() const {\n")
         w("        std::string nf__s; Encode(nf__s); return nf__s;\n    }\n")
+        # ---- clear (Decode resets to defaults first, like protobuf Parse)
+        w("    void Clear() {\n")
+        for _tag, fname, ftype, _ in cls.FIELDS:
+            if isinstance(ftype, tuple):
+                w(f"        {fname}.clear();\n")
+            else:
+                w(f"        {fname} = {_cpp_type(ftype)}{{}};\n")
+                w(f"        has_{fname} = false;\n")
+        w("    }\n")
         # ---- decode
         w("    bool Decode(const void* nf__data, size_t nf__len) {\n")
+        w("        Clear();\n")
         w("        Reader nf__r(nf__data, nf__len);\n")
         w("        while (!nf__r.done()) {\n")
         w("            uint64_t nf__key = nf__r.varint();\n")

@@ -84,7 +84,8 @@ def driver_cpp() -> str:
         "                   std::istreambuf_iterator<char>());\n"
         "    std::string out;\n"
         "    size_t off = 0; uint16_t id; std::string body;\n"
-        "    while (nfmsg::unframe(in, off, id, body)) {\n"
+        "    nfmsg::UnframeResult ur;\n"
+        "    while ((ur = nfmsg::unframe(in, off, id, body)) == nfmsg::UNFRAME_OK) {\n"
         "        std::string out2;\n"
         "        switch (id) {\n"
         f"{cases}\n"
@@ -92,6 +93,7 @@ def driver_cpp() -> str:
         "        }\n"
         "        nfmsg::frame(out, id, out2);\n"
         "    }\n"
+        "    if (ur == nfmsg::UNFRAME_ERROR) return 5;\n"
         "    if (off != in.size()) return 4;\n"
         "    fwrite(out.data(), 1, out.size(), stdout);\n"
         "    return 0;\n"
@@ -168,3 +170,40 @@ def test_cpp_varint_overlong_rejected(sdk_bin):
     body = b"\x80" * 11 + b"\x01"
     r = subprocess.run([str(sdk_bin)], input=frame(0, body), capture_output=True)
     assert r.returncode == 2  # decode failure, not UB/garbage
+
+
+def test_cpp_decode_resets_reused_object(sdk_bin, tmp_path):
+    """Decode clears prior state (protobuf Parse semantics): reusing one
+    message object across frames must not accumulate repeated fields."""
+    import textwrap
+
+    d = sdk_bin.parent
+    src = d / "reuse.cc"
+    src.write_text(textwrap.dedent('''
+        #include "nfmsg.hpp"
+        #include <cstdio>
+        int main() {
+            nfmsg::ObjectPropertyList m;
+            nfmsg::ObjectPropertyList src;
+            nfmsg::PropertyInt p; p.property_name = "HP";
+            p.has_property_name = true; p.data = 5; p.has_data = true;
+            src.property_int_list.push_back(p);
+            std::string s = src.Encode();
+            m.Decode(s.data(), s.size());
+            m.Decode(s.data(), s.size());
+            printf("%zu\\n", m.property_int_list.size());
+            return 0;
+        }
+    '''))
+    exe = d / "reuse"
+    r = subprocess.run(["g++", "-std=c++11", "-I", str(d), str(src), "-o", str(exe)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = subprocess.run([str(exe)], capture_output=True, text=True)
+    assert out.stdout.strip() == "1"
+
+
+def test_cpp_corrupt_header_is_error_not_stall(sdk_bin):
+    bad = struct.pack(">HI", 0, 3)  # total < 6: protocol error
+    r = subprocess.run([str(sdk_bin)], input=bad + b"xxxx", capture_output=True)
+    assert r.returncode == 5  # surfaced as error, not an infinite wait
