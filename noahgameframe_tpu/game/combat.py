@@ -51,6 +51,7 @@ class CombatModule(Module):
         attack_period_s: float = 1.0,
         order: int = 30,
         emit_events: bool = True,
+        use_pallas: Optional[bool] = None,
     ):
         super().__init__()
         self.class_name = class_name
@@ -64,6 +65,9 @@ class CombatModule(Module):
         self.respawn_s = float(respawn_s)
         self.attack_period_s = float(attack_period_s)
         self.emit_events = emit_events
+        # None = env-gated (NF_PALLAS=1): the fused Pallas fold kernel
+        # (ops/stencil_pallas.py); opt-in until chip-time confirms a win
+        self.use_pallas = use_pallas
         self.add_phase("aoe", self._combat_phase, order=order)
         self.add_phase("death", self._death_phase, order=order + 5)
 
@@ -150,51 +154,73 @@ class CombatModule(Module):
         table = build_cell_table(
             pos, cs.alive, feats, self.cell_size, self.width, bucket
         )
-        v = table.grid_view()
-        vx, vy = v[..., 0], v[..., 1]
-        vcamp, vscene, vgroup, vrow = v[..., 3], v[..., 4], v[..., 5], v[..., 6]
-        r2 = self.radius * self.radius
-        idt = jnp.int32
+        pallas_on = self.use_pallas
+        if pallas_on is None:
+            import os
 
-        def fold(acc, cand):
-            inc, besta, bestr = acc
-            cx = cand[:, :, None, :, 0]
-            cy = cand[:, :, None, :, 1]
-            ca = cand[:, :, None, :, 2]
-            cc = cand[:, :, None, :, 3]
-            cscene = cand[:, :, None, :, 4]
-            cgroup = cand[:, :, None, :, 5]
-            cr = cand[:, :, None, :, 6]
-            dx = vx[..., None] - cx
-            dy = vy[..., None] - cy
-            ok = (
-                (dx * dx + dy * dy <= r2)
-                & (ca != 0)  # attacking this tick (eff_atk 0 = bystander)
-                & (cc != vcamp[..., None])  # no friendly fire
-                & (cscene == vscene[..., None])  # same scene...
-                & (cgroup == vgroup[..., None])  # ...and group
-                & (cr != vrow[..., None])  # not self
-            )
-            inc = inc + jnp.sum(jnp.where(ok, ca, 0.0), axis=-1).astype(idt)
-            # strongest attacker; ties resolve to the first candidate in
-            # (stencil, slot) order — slots hold ascending rows, so the
-            # within-shift tie-break is min-row
-            sa = jnp.where(ok, ca, -1.0)
-            m = jnp.max(sa, axis=-1)
-            first = jnp.min(
-                jnp.where(sa >= m[..., None], cr, jnp.inf), axis=-1
-            )
-            better = m > besta
-            besta = jnp.where(better, m, besta)
-            bestr = jnp.where(better, first.astype(idt), bestr)
-            return inc, besta, bestr
+            pallas_on = os.environ.get("NF_PALLAS", "") == "1"
+        if pallas_on:
+            import jax
 
-        zeros = jnp.zeros(v.shape[:3], idt)
-        inc, _besta, bestr = stencil_fold(
-            table,
-            fold,
-            (zeros, jnp.zeros(v.shape[:3], f32) - 1.0, zeros - 1),
-        )
+            from ..ops.stencil_pallas import combat_fold_pallas, planes_from_table
+
+            planes = planes_from_table(table.payload, self.width, bucket)
+            inc, bestr = combat_fold_pallas(
+                planes,
+                self.radius,
+                self.width,
+                # native lowering only on TPU-class backends; anything
+                # else (cpu, gpu, metal) runs the kernel interpreted
+                interpret=jax.default_backend() not in ("tpu", "axon"),
+            )
+        else:
+            v = table.grid_view()
+            vx, vy = v[..., 0], v[..., 1]
+            vcamp, vscene, vgroup, vrow = (
+                v[..., 3], v[..., 4], v[..., 5], v[..., 6]
+            )
+            r2 = self.radius * self.radius
+            idt = jnp.int32
+
+            def fold(acc, cand):
+                inc, besta, bestr = acc
+                cx = cand[:, :, None, :, 0]
+                cy = cand[:, :, None, :, 1]
+                ca = cand[:, :, None, :, 2]
+                cc = cand[:, :, None, :, 3]
+                cscene = cand[:, :, None, :, 4]
+                cgroup = cand[:, :, None, :, 5]
+                cr = cand[:, :, None, :, 6]
+                dx = vx[..., None] - cx
+                dy = vy[..., None] - cy
+                ok = (
+                    (dx * dx + dy * dy <= r2)
+                    & (ca != 0)  # attacking this tick (eff_atk 0 = bystander)
+                    & (cc != vcamp[..., None])  # no friendly fire
+                    & (cscene == vscene[..., None])  # same scene...
+                    & (cgroup == vgroup[..., None])  # ...and group
+                    & (cr != vrow[..., None])  # not self
+                )
+                inc = inc + jnp.sum(jnp.where(ok, ca, 0.0), axis=-1).astype(idt)
+                # strongest attacker; ties resolve to the first candidate
+                # in (stencil, slot) order — slots hold ascending rows,
+                # so the within-shift tie-break is min-row
+                sa = jnp.where(ok, ca, -1.0)
+                m = jnp.max(sa, axis=-1)
+                first = jnp.min(
+                    jnp.where(sa >= m[..., None], cr, jnp.inf), axis=-1
+                )
+                better = m > besta
+                besta = jnp.where(better, m, besta)
+                bestr = jnp.where(better, first.astype(idt), bestr)
+                return inc, besta, bestr
+
+            zeros = jnp.zeros(v.shape[:3], idt)
+            inc, _besta, bestr = stencil_fold(
+                table,
+                fold,
+                (zeros, jnp.zeros(v.shape[:3], f32) - 1.0, zeros - 1),
+            )
         pulled = pull(table, jnp.stack([inc, bestr], axis=-1), fill=(0, -1))
         incoming = pulled[..., 0]
         # dead-but-not-yet-respawned victims take no damage

@@ -1,0 +1,135 @@
+"""Pallas TPU kernel for the combat stencil fold.
+
+The XLA path (ops/stencil.py stencil_fold) walks the 3x3 neighborhood as
+nine shifted slices of the padded cell table — nine reads of the table
+from HBM, fused per shift.  This kernel makes the whole fold ONE pass:
+the grid iterates over cell rows, Pallas streams each row's three
+neighbor rows into VMEM (the same padded table is bound three times with
+block index maps y, y+1, y+2 — overlapping, read-only), and the nine
+shifted pairwise reductions run on-core against resident data.
+
+Layout: the table rides as [H+2, F, K, W+2] so the wide W axis lands on
+vector lanes and K on sublanes; per-program blocks are [1, F, K, W+2].
+Outputs are [H, 3, K, W] (incoming, best-atk, best-row planes).
+
+Semantics are identical to CombatModule's XLA fold (same stencil order,
+same tie-breaks) — pinned by tests/test_stencil_pallas.py, which runs
+this kernel in interpret mode on CPU against the XLA path.  On real TPU
+hardware the kernel compiles natively; enable with NF_PALLAS=1 (opt-in
+until chip-time confirms a win over the already-fused XLA fold).
+
+Feature plane order (must match CombatModule's feats stack + occ):
+    0: x   1: y   2: eff_atk   3: camp   4: scene   5: group   6: row
+    7: occupancy
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+F_X, F_Y, F_ATK, F_CAMP, F_SCENE, F_GROUP, F_ROW, F_OCC = range(8)
+N_FEATS = 8
+
+
+def _kernel(top_ref, mid_ref, bot_ref, out_ref, *, w: int, r2: float):
+    k = mid_ref.shape[2]
+    vx = mid_ref[0, F_X, :, 1 : w + 1]
+    vy = mid_ref[0, F_Y, :, 1 : w + 1]
+    vcamp = mid_ref[0, F_CAMP, :, 1 : w + 1]
+    vscene = mid_ref[0, F_SCENE, :, 1 : w + 1]
+    vgroup = mid_ref[0, F_GROUP, :, 1 : w + 1]
+    vrow = mid_ref[0, F_ROW, :, 1 : w + 1]
+
+    inc = jnp.zeros((k, w), jnp.int32)
+    besta = jnp.full((k, w), -1.0, jnp.float32)
+    bestr = jnp.full((k, w), -1.0, jnp.float32)
+
+    # stencil order (dy, dx) ascending — identical to ops.stencil.STENCIL
+    for ref in (top_ref, mid_ref, bot_ref):
+        for dx in (0, 1, 2):
+            cx = ref[0, F_X, :, dx : dx + w]
+            cy = ref[0, F_Y, :, dx : dx + w]
+            ca = ref[0, F_ATK, :, dx : dx + w]
+            cc = ref[0, F_CAMP, :, dx : dx + w]
+            cs = ref[0, F_SCENE, :, dx : dx + w]
+            cg = ref[0, F_GROUP, :, dx : dx + w]
+            cr = ref[0, F_ROW, :, dx : dx + w]
+            ddx = vx[:, None, :] - cx[None, :, :]
+            ddy = vy[:, None, :] - cy[None, :, :]
+            cab = ca[None, :, :]
+            ok = (
+                (ddx * ddx + ddy * ddy <= r2)
+                & (cab != 0.0)
+                & (cc[None, :, :] != vcamp[:, None, :])
+                & (cs[None, :, :] == vscene[:, None, :])
+                & (cg[None, :, :] == vgroup[:, None, :])
+                & (cr[None, :, :] != vrow[:, None, :])
+            )
+            inc = inc + jnp.sum(
+                jnp.where(ok, cab, 0.0), axis=1
+            ).astype(jnp.int32)
+            sa = jnp.where(ok, cab, -1.0)
+            sa = jnp.broadcast_to(sa, (k, k, w))
+            m = jnp.max(sa, axis=1)
+            first = jnp.min(
+                jnp.where(sa >= m[:, None, :],
+                          jnp.broadcast_to(cr[None, :, :], (k, k, w)),
+                          jnp.inf),
+                axis=1,
+            )
+            better = m > besta
+            besta = jnp.where(better, m, besta)
+            bestr = jnp.where(better, first, bestr)
+
+    # bitcast keeps the exact int32 damage total through the f32 plane
+    # (a value cast would round above 2^24)
+    out_ref[0, 0] = jax.lax.bitcast_convert_type(inc, jnp.float32)
+    out_ref[0, 1] = besta
+    out_ref[0, 2] = bestr
+
+
+def combat_fold_pallas(
+    table_planes: jnp.ndarray,
+    radius: float,
+    width: int,
+    interpret: bool = False,
+):
+    """table_planes: [H+2, F, K, W+2] padded feature planes (f32).
+    Returns (inc [H,W,K] int32, bestr [H,W,K] int32)."""
+    hp, f, k, wp = table_planes.shape
+    h = hp - 2
+    w = wp - 2
+    assert f == N_FEATS and w == width
+    row_spec = lambda off: pl.BlockSpec(  # noqa: E731
+        (1, f, k, wp), lambda y, o=off: (y + o, 0, 0, 0)
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, w=w, r2=float(radius) * float(radius)),
+        grid=(h,),
+        in_specs=[row_spec(0), row_spec(1), row_spec(2)],
+        out_specs=pl.BlockSpec((1, 3, k, w), lambda y: (y, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((h, 3, k, w), jnp.float32),
+        interpret=interpret,
+    )(table_planes, table_planes, table_planes)
+    inc = jax.lax.bitcast_convert_type(
+        out[:, 0].transpose(0, 2, 1), jnp.int32
+    )  # [H, W, K]
+    bestr = out[:, 2].transpose(0, 2, 1).astype(jnp.int32)
+    return inc, bestr
+
+
+def planes_from_table(payload: jnp.ndarray, width: int, bucket: int) -> jnp.ndarray:
+    """CellTable payload [(H*W*K)+1, F+1] -> padded planes [H+2, F, K, W+2].
+
+    The payload's last (occupancy) column becomes plane F_OCC; border
+    cells pad with zero occupancy so edge neighbors mask out exactly like
+    the XLA fold's zero padding."""
+    h = w = width
+    k = bucket
+    v = payload[:-1].reshape(h, w, k, N_FEATS)
+    planes = v.transpose(0, 3, 2, 1)  # [H, F, K, W]
+    return jnp.pad(planes, ((1, 1), (0, 0), (0, 0), (1, 1)))
