@@ -18,9 +18,9 @@ this kernel in interpret mode on CPU against the XLA path.  On real TPU
 hardware the kernel compiles natively; enable with NF_PALLAS=1 (opt-in
 until chip-time confirms a win over the already-fused XLA fold).
 
-Feature plane order (must match CombatModule's feats stack + occ):
+Feature plane order (CombatModule's feats stack; the table's
+occupancy column is dropped — empty slots carry eff_atk 0 and mask out):
     0: x   1: y   2: eff_atk   3: camp   4: scene   5: group   6: row
-    7: occupancy
 """
 
 from __future__ import annotations
@@ -31,8 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-F_X, F_Y, F_ATK, F_CAMP, F_SCENE, F_GROUP, F_ROW, F_OCC = range(8)
-N_FEATS = 8
+F_X, F_Y, F_ATK, F_CAMP, F_SCENE, F_GROUP, F_ROW = range(7)
+N_FEATS = 7
 
 
 def _kernel(top_ref, mid_ref, bot_ref, out_ref, *, w: int, r2: float):
@@ -125,11 +125,11 @@ def combat_fold_pallas(
 def planes_from_table(payload: jnp.ndarray, width: int, bucket: int) -> jnp.ndarray:
     """CellTable payload [(H*W*K)+1, F+1] -> padded planes [H+2, F, K, W+2].
 
-    The payload's last (occupancy) column becomes plane F_OCC; border
-    cells pad with zero occupancy so edge neighbors mask out exactly like
-    the XLA fold's zero padding."""
+    The occupancy column is dropped (the kernel masks empty slots via
+    eff_atk == 0); border cells pad with zeros so edge neighbors mask
+    out exactly like the XLA fold's zero padding."""
     h = w = width
     k = bucket
-    v = payload[:-1].reshape(h, w, k, N_FEATS)
+    v = payload[:-1, :N_FEATS].reshape(h, w, k, N_FEATS)
     planes = v.transpose(0, 3, 2, 1)  # [H, F, K, W]
     return jnp.pad(planes, ((1, 1), (0, 0), (0, 0), (1, 1)))

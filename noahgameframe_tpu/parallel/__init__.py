@@ -6,9 +6,21 @@ here to jax.sharding over ICI/DCN.
 """
 
 from .mesh import SHARD_AXIS, make_mesh, replicated, row_sharding
+from .multihost import (
+    DistRendezvous,
+    global_mesh,
+    init_distributed,
+    rendezvous_via_master,
+    serve_dist,
+)
 from .shard import ShardedKernel, shard_rows_by_cell, world_shardings
 
 __all__ = [
+    "DistRendezvous",
+    "global_mesh",
+    "init_distributed",
+    "rendezvous_via_master",
+    "serve_dist",
     "SHARD_AXIS",
     "ShardedKernel",
     "make_mesh",

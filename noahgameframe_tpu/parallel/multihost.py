@@ -1,0 +1,154 @@
+"""Multi-host bootstrap: the Master role as the jax.distributed rendezvous.
+
+The reference scales across hosts by every server process dialing the
+Master for registration and discovery (SURVEY §3.5).  The TPU build's
+data plane scales the same way conceptually, but the transport is the
+JAX distributed runtime: each host process calls
+``jax.distributed.initialize(coordinator, num_processes, process_id)``,
+after which ``jax.devices()`` spans the whole pod and the standard
+``make_mesh()``/``ShardedKernel`` path shards the world over ICI/DCN
+with XLA collectives — no NCCL/MPI, no hand-rolled relay hop.
+
+What this module adds:
+
+- :func:`init_distributed` — env-aware wrapper over
+  ``jax.distributed.initialize`` (honours the standard
+  ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``
+  variables, no-ops cleanly for single-process runs).
+- :func:`global_mesh` — a mesh over every device in the initialized
+  process group (locals + remotes).
+- Master-backed rendezvous: :meth:`MasterRole hosts /dist <register_dist>`
+  so worker hosts can discover (coordinator, num_processes, process_id)
+  from the same place they already register their server roles —
+  :func:`rendezvous_via_master` polls it until the expected host count
+  has arrived, mirroring the reference's "start all, watch the master
+  go green" bring-up.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+from typing import Dict, Optional, Tuple
+
+from .mesh import SHARD_AXIS
+
+
+def init_distributed(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize the JAX distributed runtime (idempotent).
+
+    Arguments default to the standard env vars; with one process (or no
+    configuration at all) this is a no-op and single-host behavior is
+    unchanged.  Returns True when a multi-process group was joined."""
+    import jax
+
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    if num_processes <= 1 or coordinator is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def global_mesh(axis: str = SHARD_AXIS):
+    """1-D mesh over EVERY device of the process group (after
+    init_distributed, that includes remote hosts' chips)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+class DistRendezvous:
+    """Host-side assignment table the Master serves at /dist.
+
+    First registrant's announced endpoint becomes the coordinator;
+    process ids are dense in arrival order.  `expected` is the pod's
+    host-process count (from deployment config, like MaxOnline rows in
+    Server.xml)."""
+
+    def __init__(self, expected: int) -> None:
+        self.expected = int(expected)
+        self._procs: Dict[str, int] = {}
+        self._coordinator: Optional[str] = None
+
+    def register(self, host_key: str, coord_endpoint: str) -> dict:
+        if host_key not in self._procs:
+            if len(self._procs) >= self.expected:
+                return {"error": "pod full", "expected": self.expected}
+            self._procs[host_key] = len(self._procs)
+            if self._coordinator is None:
+                self._coordinator = coord_endpoint
+        return self.view(host_key)
+
+    def view(self, host_key: Optional[str] = None) -> dict:
+        out = {
+            "coordinator": self._coordinator,
+            "num_processes": self.expected,
+            "registered": len(self._procs),
+            "ready": len(self._procs) >= self.expected,
+        }
+        if host_key is not None and host_key in self._procs:
+            out["process_id"] = self._procs[host_key]
+        return out
+
+
+def serve_dist(master_role, expected: int) -> DistRendezvous:
+    """Attach the /dist rendezvous endpoint to a MasterRole's HTTP
+    server: GET /dist?host=<key>&coord=<ip:port> registers and returns
+    the assignment; GET /dist reports status."""
+    rz = DistRendezvous(expected)
+
+    def handler(_path: str, params: Dict[str, str]) -> dict:
+        host = params.get("host")
+        coord = params.get("coord", "")
+        if host:
+            return rz.register(host, coord)
+        return rz.view()
+
+    master_role.http.route("/dist", handler)
+    return rz
+
+
+def rendezvous_via_master(
+    master_http: str,
+    host_key: str,
+    coord_endpoint: str,
+    timeout_s: float = 60.0,
+    poll_s: float = 0.5,
+) -> Tuple[str, int, int]:
+    """Register with the master's /dist endpoint and wait until every
+    expected host has arrived.  Returns (coordinator, num_processes,
+    process_id) ready to hand to init_distributed."""
+    base = f"http://{master_http}/dist?host={host_key}&coord={coord_endpoint}"
+    deadline = time.time() + timeout_s
+    assignment = None
+    while time.time() < deadline:
+        with urllib.request.urlopen(base, timeout=5) as r:
+            assignment = json.loads(r.read())
+        if "error" in assignment:
+            raise RuntimeError(f"dist rendezvous refused: {assignment}")
+        if assignment.get("ready"):
+            return (
+                assignment["coordinator"],
+                int(assignment["num_processes"]),
+                int(assignment["process_id"]),
+            )
+        time.sleep(poll_s)
+    raise TimeoutError(
+        f"dist rendezvous incomplete after {timeout_s}s: {assignment}"
+    )

@@ -1,0 +1,78 @@
+"""Multi-host bootstrap: master-served /dist rendezvous + env-aware
+jax.distributed wrapper (single-process paths; real pods reuse them)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+from noahgameframe_tpu.net.roles.base import RoleConfig
+from noahgameframe_tpu.net.roles.master import MasterRole
+from noahgameframe_tpu.parallel import (
+    DistRendezvous,
+    global_mesh,
+    init_distributed,
+    rendezvous_via_master,
+    serve_dist,
+)
+
+
+def test_init_distributed_noop_for_single_process(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    assert init_distributed() is False  # single host: nothing to join
+    mesh = global_mesh()
+    assert mesh.devices.size >= 1  # local devices still mesh
+
+
+def test_dist_rendezvous_assignments():
+    rz = DistRendezvous(expected=3)
+    a = rz.register("hostA", "10.0.0.1:1234")
+    b = rz.register("hostB", "10.0.0.2:1234")
+    a2 = rz.register("hostA", "ignored")  # idempotent re-register
+    assert a["process_id"] == 0 and b["process_id"] == 1
+    assert a2["process_id"] == 0
+    assert a["coordinator"] == "10.0.0.1:1234"  # first registrant wins
+    assert not b["ready"]
+    c = rz.register("hostC", "x")
+    assert c["ready"] and c["num_processes"] == 3
+    assert "error" in rz.register("hostD", "y")  # pod full
+
+
+def test_rendezvous_via_master_http():
+    m = MasterRole(RoleConfig(3, 1, "M", "127.0.0.1", 0), http_port=0)
+    serve_dist(m, expected=2)
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            m.execute()
+            time.sleep(0.002)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        port = m.http.port
+        results = {}
+
+        def join(key, coord):
+            results[key] = rendezvous_via_master(
+                f"127.0.0.1:{port}", key, coord, timeout_s=10.0, poll_s=0.05
+            )
+
+        t1 = threading.Thread(target=join, args=("h1", "10.1.1.1:9999"))
+        t1.start()
+        time.sleep(0.2)
+        # status endpoint reports partial registration
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/dist", timeout=5) as r:
+            status = json.loads(r.read())
+        assert status["registered"] == 1 and not status["ready"]
+        join("h2", "10.1.1.2:9999")
+        t1.join(timeout=10)
+        assert results["h1"][0] == "10.1.1.1:9999"
+        assert results["h1"][1] == 2
+        assert {results["h1"][2], results["h2"][2]} == {0, 1}
+    finally:
+        stop.set()
+        t.join(timeout=2)
+        m.shut()
