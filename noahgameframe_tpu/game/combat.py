@@ -66,7 +66,11 @@ class CombatModule(Module):
         self.attack_period_s = float(attack_period_s)
         self.emit_events = emit_events
         # None = env-gated (NF_PALLAS=1): the fused Pallas fold kernel
-        # (ops/stencil_pallas.py); opt-in until chip-time confirms a win
+        # (ops/stencil_pallas.py); opt-in until chip-time confirms a win.
+        # (The stencil engine is the only combat engine: at honest bucket
+        # sizes it beats the old per-candidate-gather pipeline even on a
+        # single CPU core — 103 ms vs 186 ms at 100k — and by ~25x on a
+        # v5e, where irregular gathers run at ~1% of HBM bandwidth.)
         self.use_pallas = use_pallas
         self.add_phase("aoe", self._combat_phase, order=order)
         self.add_phase("death", self._death_phase, order=order + 5)
@@ -172,6 +176,7 @@ class CombatModule(Module):
                 # native lowering only on TPU-class backends; anything
                 # else (cpu, gpu, metal) runs the kernel interpreted
                 interpret=jax.default_backend() not in ("tpu", "axon"),
+                bucket=bucket,
             )
         else:
             v = table.grid_view()

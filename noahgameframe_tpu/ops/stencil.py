@@ -82,18 +82,21 @@ class CellTable(NamedTuple):
 
 
 def auto_bucket(capacity: int, width: int, lo: int = 8, hi: int = 256) -> int:
-    """Pick K so uniform occupancy ~Poisson(capacity/cells) overflows ~never:
-    mean + 4*sqrt(mean) + 4, rounded up to a multiple of 8 within [lo, hi].
+    """Pick K so uniform occupancy ~Poisson(capacity/cells) stays under
+    the overflow budget: mean + 2.5*sqrt(mean) + 2, rounded up to a
+    multiple of 4 within [lo, hi].  Fold cost scales with K^2, so the
+    margin is the thinnest that keeps expected drops well below 0.1% of
+    entities (capacity already overstates live density by up to 2x,
+    which is extra headroom; the bound is pinned by tests/test_stencil.py).
 
     Entities beyond a cell's K slots are dropped from that query (counted
     in CellTable.dropped) — they neither see nor are seen by neighbors
-    that tick.  The auto size keeps that below 0.1% for near-uniform
-    densities (pinned by tests/test_stencil.py); callers passing an
-    explicit small bucket accept drops under crowding."""
+    that tick.  Callers passing an explicit small bucket accept drops
+    under crowding."""
     lam = capacity / float(max(width * width, 1))
-    k = int(math.ceil(lam + 4.0 * math.sqrt(max(lam, 1.0)) + 4.0))
+    k = int(math.ceil(lam + 2.5 * math.sqrt(max(lam, 1.0)) + 2.0))
     k = max(lo, min(hi, k))
-    return (k + 7) // 8 * 8
+    return (k + 3) // 4 * 4
 
 
 def build_cell_table(

@@ -97,9 +97,11 @@ def combat_fold_pallas(
     radius: float,
     width: int,
     interpret: bool = False,
+    bucket: int = 0,
 ):
-    """table_planes: [H+2, F, K, W+2] padded feature planes (f32).
-    Returns (inc [H,W,K] int32, bestr [H,W,K] int32)."""
+    """table_planes: [H+2, F, Kpad, W+2] padded feature planes (f32,
+    from planes_from_table).  Returns (inc [H,W,K] int32, bestr
+    [H,W,K] int32) sliced back to `bucket` slots (0 = keep Kpad)."""
     hp, f, k, wp = table_planes.shape
     h = hp - 2
     w = wp - 2
@@ -117,8 +119,11 @@ def combat_fold_pallas(
     )(table_planes, table_planes, table_planes)
     inc = jax.lax.bitcast_convert_type(
         out[:, 0].transpose(0, 2, 1), jnp.int32
-    )  # [H, W, K]
+    )  # [H, W, Kpad]
     bestr = out[:, 2].transpose(0, 2, 1).astype(jnp.int32)
+    if bucket and bucket < k:
+        inc = inc[..., :bucket]
+        bestr = bestr[..., :bucket]
     return inc, bestr
 
 
@@ -127,9 +132,13 @@ def planes_from_table(payload: jnp.ndarray, width: int, bucket: int) -> jnp.ndar
 
     The occupancy column is dropped (the kernel masks empty slots via
     eff_atk == 0); border cells pad with zeros so edge neighbors mask
-    out exactly like the XLA fold's zero padding."""
+    out exactly like the XLA fold's zero padding.  K also pads up to a
+    multiple of 8 so the sublane axis stays tile-aligned on real TPUs
+    (pad slots are all-zero => eff_atk 0 => masked; the caller slices
+    the outputs back to the table's K)."""
     h = w = width
     k = bucket
     v = payload[:-1, :N_FEATS].reshape(h, w, k, N_FEATS)
     planes = v.transpose(0, 3, 2, 1)  # [H, F, K, W]
-    return jnp.pad(planes, ((1, 1), (0, 0), (0, 0), (1, 1)))
+    k_pad = (-k) % 8
+    return jnp.pad(planes, ((1, 1), (0, 0), (0, k_pad), (1, 1)))
