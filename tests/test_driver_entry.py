@@ -51,3 +51,20 @@ def test_dryrun_multichip_forces_cpu_and_finishes():
     r = _run(["__graft_entry__.py", "multichip", "4"], timeout=180)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "dryrun_multichip OK" in r.stdout
+
+
+def test_entry_compiles_and_steps():
+    """The driver compile-checks entry() single-chip; keep it compiling
+    (conftest has already forced the CPU platform in-process)."""
+    sys.path.insert(0, REPO)
+    try:
+        import jax
+
+        import __graft_entry__ as ge
+
+        fn, args = ge.entry()
+        st, summary = jax.jit(fn)(*args)
+        jax.block_until_ready(summary)
+        assert summary.ndim == 1
+    finally:
+        sys.path.remove(REPO)

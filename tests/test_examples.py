@@ -17,6 +17,7 @@ REPO = Path(__file__).resolve().parent.parent
     "tutorial2_properties.py",
     "tutorial3_heartbeat_events.py",
     "tutorial4_actor.py",
+    "tutorial5_sharded_world.py",
 ])
 def test_tutorial_runs(script):
     r = subprocess.run(
