@@ -75,11 +75,11 @@ def _emit(payload: dict) -> None:
 
 
 def _grid_overflow_max(world) -> int:
-    """Rebuild the combat cell-table from the final state once (outside
-    the timed region) and report entities dropped by bucket overflow —
-    silent drops were a round-1 finding.  This is exactly the table the
-    combat phase builds (all alive entities, auto-sized buckets), so it is
-    the real per-tick drop count, not an upper bound."""
+    """Rebuild the combat victim cell-table from the final state once
+    (outside the timed region) and report entities dropped by bucket
+    overflow — silent drops were a round-1 finding.  This is exactly the
+    table the combat phase builds (all alive entities, auto-sized
+    buckets), so it is the real per-tick drop count, not an upper bound."""
     try:
         import jax.numpy as jnp
 
@@ -104,6 +104,52 @@ def _grid_overflow_max(world) -> int:
             bucket,
         )
         return int(table.dropped)
+    except Exception:  # noqa: BLE001
+        return -1
+
+
+def _att_overflow_max(world) -> int:
+    """Worst-phase attacker-table drop count: replay each firing residue
+    of the attack timer against the final positions (the attacker
+    candidate table only holds one residue class per tick under staggered
+    arming — a dropped attacker is an attack that doesn't land).  Exact
+    for the benchmark world (timers keep their armed phase forever:
+    next_fire advances by one interval per firing)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from noahgameframe_tpu.ops.stencil import build_cell_table
+
+        combat = getattr(world, "combat", None)
+        if combat is None:
+            return -1
+        k = world.kernel
+        cname = combat.class_name
+        spec = k.store.spec(cname)
+        cs = k.state.classes[cname]
+        pos = cs.vec[:, spec.slot("Position").col, :2]
+        n = pos.shape[0]
+        att_bucket = combat.resolved_att_bucket(n)
+        slot = k.schedule.slot(cname, "Attack")
+        t = cs.timers
+        interval = max(1, k.schedule.ticks_of(combat.attack_period_s))
+        armed = t.active[:, slot] & cs.alive
+        residue = t.next_fire[:, slot] % interval
+
+        @jax.jit
+        def drops_of(p):
+            mask = armed & (residue == p)
+            return build_cell_table(
+                pos,
+                mask,
+                jnp.zeros((n, 0), jnp.float32),
+                combat.cell_size,
+                combat.width,
+                att_bucket,
+            ).dropped
+
+        return max(int(drops_of(p)) for p in range(interval))
     except Exception:  # noqa: BLE001
         return -1
 
@@ -259,6 +305,7 @@ def run_bench(args) -> dict:
             "platform": dev.platform,
             "combat": not args.no_combat,
             "grid_overflow_max": _grid_overflow_max(world),
+            "att_overflow_max": _att_overflow_max(world),
         },
     }
 

@@ -72,6 +72,13 @@ class CombatModule(Module):
         # single CPU core — 103 ms vs 186 ms at 100k — and by ~25x on a
         # v5e, where irregular gathers run at ~1% of HBM bandwidth.)
         self.use_pallas = use_pallas
+        # fraction of the population the attacker candidate table is sized
+        # for; 1.0 (safe default) means "everyone could fire on one tick".
+        # arm_all(stagger=True) lowers it to dt/attack_period — staggered
+        # phases make instantaneous attacker density ~duty * population,
+        # and the candidate table (the 9x-scanned side of the fold)
+        # shrinks by the same factor.
+        self._attacker_duty = 1.0
         self.add_phase("aoe", self._combat_phase, order=order)
         self.add_phase("death", self._death_phase, order=order + 5)
 
@@ -81,25 +88,52 @@ class CombatModule(Module):
         # timer slots must exist before the world is built
         self.kernel.schedule.register_timer(self.class_name, ATTACK_TIMER)
 
-    def arm_all(self) -> None:
-        """Arm the attack heartbeat on every live row (benchmark seeding)."""
+    def arm_all(self, stagger: bool = True) -> None:
+        """Arm the attack heartbeat on every live row (benchmark seeding).
+
+        stagger=True spreads first firings evenly across the attack
+        period (`1 + row % interval` ticks) — the batch equivalent of the
+        reference arming each object's heartbeat at its own creation time
+        (NFCScheduleModule AddSchedule at create).  Synchronized arming
+        (stagger=False) makes every entity fire on the same tick, so the
+        attacker candidate table must be sized for the full population."""
         import numpy as np
 
         k = self.kernel
         cs = k.state.classes[self.class_name]
         rows = np.flatnonzero(np.asarray(cs.alive))
+        interval = k.schedule.ticks_of(self.attack_period_s)
+        delays = 1 + (rows % interval) if (stagger and interval > 1) else None
         k.state = k.schedule.set_timer_rows(
-            k.state, self.class_name, rows, ATTACK_TIMER, self.attack_period_s
+            k.state, self.class_name, rows, ATTACK_TIMER, self.attack_period_s,
+            start_delay_ticks=delays,
         )
+        new_duty = (1.0 / interval) if delays is not None else 1.0
+        if new_duty != self._attacker_duty:
+            self._attacker_duty = new_duty
+            # candidate-bucket size is baked into the traced tick
+            k.invalidate()
 
     def resolved_bucket(self, capacity: int) -> int:
-        """The cell-table bucket size the combat phase actually uses —
-        shared with bench.py's overflow monitor so both stay in sync."""
+        """The victim cell-table bucket size the combat phase actually
+        uses — shared with bench.py's overflow monitor so both stay in
+        sync."""
         return (
             self.bucket
             if self.bucket is not None
             else auto_bucket(capacity, self.width)
         )
+
+    def resolved_att_bucket(self, capacity: int) -> int:
+        """The attacker candidate-table bucket size: sized for the
+        instantaneous attacker density (capacity * duty), never larger
+        than the victim bucket.  With staggered arming duty is
+        dt/attack_period, so the 9x-scanned candidate side of the fold
+        shrinks ~duty-fold while victims stay fully resident."""
+        import math
+
+        eff = max(1, int(math.ceil(capacity * self._attacker_duty)))
+        return min(auto_bucket(eff, self.width, lo=4), self.resolved_bucket(capacity))
 
     # -- device phases -------------------------------------------------------
 
@@ -130,33 +164,39 @@ class CombatModule(Module):
         # overlapping coordinates in different cells never interact
         n = pos.shape[0]
         bucket = self.resolved_bucket(n)
-        # One table over all alive entities; non-attackers carry eff_atk 0
-        # and are masked out on the candidate side.  f32 carries each int
-        # column exactly for values < 2^24 (row < capacity, atk, scene id,
-        # group id — scene and group ride in separate columns so neither
-        # magnitude compounds); per-shift damage sums stay < 2^24 because
-        # a shift has at most K candidates, and the cross-shift total
-        # accumulates in exact int32.  Victims beyond a cell's K slots are
-        # dropped (invisible AND invulnerable) that tick; `auto_bucket`
-        # keeps that ~zero, and CellTable.dropped counts it.
+        att_bucket = self.resolved_att_bucket(n)
+        # TWO tables: every alive entity is RESIDENT as a victim (K deep),
+        # but only this tick's attackers ride the 9x-scanned candidate
+        # side (K_att deep — with staggered attack phases K_att is
+        # ~duty*K, which is where the fold's pairwise cost lives).  f32
+        # carries each int column exactly for values < 2^24 (row <
+        # capacity, atk, scene id, group id — scene and group ride in
+        # separate columns so neither magnitude compounds); per-shift
+        # damage sums stay < 2^24 because a shift has at most K_att
+        # candidates, and the cross-shift total accumulates in exact
+        # int32.  Entities beyond a cell's bucket are dropped from that
+        # table for the tick (victim table: invisible AND invulnerable;
+        # attacker table: the attack doesn't land) — `auto_bucket` keeps
+        # both ~zero and CellTable.dropped counts them.
         f32 = jnp.float32
-        eff_atk = jnp.where(attacking, atk, 0).astype(f32)
+        rows_f = jnp.arange(n, dtype=f32)
+        camp_f = camp.astype(f32)
         scene_f = cs.i32[:, spec.slot("SceneID").col].astype(f32)
         group_f = cs.i32[:, spec.slot("GroupID").col].astype(f32)
-        feats = jnp.stack(
-            [
-                pos[:, 0],
-                pos[:, 1],
-                eff_atk,
-                camp.astype(f32),
-                scene_f,
-                group_f,
-                jnp.arange(n, dtype=f32),
-            ],
+        vic_feats = jnp.stack(
+            [pos[:, 0], pos[:, 1], camp_f, scene_f, group_f, rows_f],
             axis=-1,
         )
-        table = build_cell_table(
-            pos, cs.alive, feats, self.cell_size, self.width, bucket
+        vic_table = build_cell_table(
+            pos, cs.alive, vic_feats, self.cell_size, self.width, bucket
+        )
+        eff_atk = jnp.where(attacking, atk, 0).astype(f32)
+        att_feats = jnp.stack(
+            [pos[:, 0], pos[:, 1], eff_atk, camp_f, scene_f, group_f, rows_f],
+            axis=-1,
+        )
+        att_table = build_cell_table(
+            pos, attacking, att_feats, self.cell_size, self.width, att_bucket
         )
         pallas_on = self.use_pallas
         if pallas_on is None:
@@ -166,23 +206,21 @@ class CombatModule(Module):
         if pallas_on:
             import jax
 
-            from ..ops.stencil_pallas import combat_fold_pallas, planes_from_table
+            from ..ops.stencil_pallas import combat_fold_pallas
 
-            planes = planes_from_table(table.payload, self.width, bucket)
             inc, bestr = combat_fold_pallas(
-                planes,
+                vic_table,
+                att_table,
                 self.radius,
-                self.width,
                 # native lowering only on TPU-class backends; anything
                 # else (cpu, gpu, metal) runs the kernel interpreted
                 interpret=jax.default_backend() not in ("tpu", "axon"),
-                bucket=bucket,
             )
         else:
-            v = table.grid_view()
+            v = vic_table.grid_view()
             vx, vy = v[..., 0], v[..., 1]
             vcamp, vscene, vgroup, vrow = (
-                v[..., 3], v[..., 4], v[..., 5], v[..., 6]
+                v[..., 2], v[..., 3], v[..., 4], v[..., 5]
             )
             r2 = self.radius * self.radius
             idt = jnp.int32
@@ -200,7 +238,7 @@ class CombatModule(Module):
                 dy = vy[..., None] - cy
                 ok = (
                     (dx * dx + dy * dy <= r2)
-                    & (ca != 0)  # attacking this tick (eff_atk 0 = bystander)
+                    & (ca != 0)  # a real attacker (empty slots carry 0)
                     & (cc != vcamp[..., None])  # no friendly fire
                     & (cscene == vscene[..., None])  # same scene...
                     & (cgroup == vgroup[..., None])  # ...and group
@@ -222,11 +260,28 @@ class CombatModule(Module):
 
             zeros = jnp.zeros(v.shape[:3], idt)
             inc, _besta, bestr = stencil_fold(
-                table,
+                att_table,
                 fold,
                 (zeros, jnp.zeros(v.shape[:3], f32) - 1.0, zeros - 1),
             )
-        pulled = pull(table, jnp.stack([inc, bestr], axis=-1), fill=(0, -1))
+        if self.emit_events:
+            # runtime overflow signal: the duty-sized attacker bucket is
+            # baked into the traced tick, so arming patterns that
+            # concentrate attackers into one residue class (e.g. a spawn
+            # wave armed synchronously AFTER arm_all's staggered seeding)
+            # would otherwise drop attacks silently.  Subscribe batch to
+            # ON_COMBAT_TABLE_OVERFLOW to observe it; bench.py replays
+            # the residue classes offline for the same number.
+            total_drop = vic_table.dropped + att_table.dropped
+            mask0 = jnp.zeros((n,), bool).at[0].set(total_drop > 0)
+            ctx.emit(
+                int(GameEvent.ON_COMBAT_TABLE_OVERFLOW),
+                cname,
+                mask0,
+                dropped_victims=jnp.broadcast_to(vic_table.dropped, (n,)),
+                dropped_attackers=jnp.broadcast_to(att_table.dropped, (n,)),
+            )
+        pulled = pull(vic_table, jnp.stack([inc, bestr], axis=-1), fill=(0, -1))
         incoming = pulled[..., 0]
         # dead-but-not-yet-respawned victims take no damage
         incoming = jnp.where(cs.alive & (hp > 0), incoming, 0)

@@ -80,6 +80,10 @@ class GameEvent(enum.IntEnum):
     ON_LEVEL_UP = 2
     ON_NPC_RESPAWN = 3
     ON_USE_SKILL_RESULT = 4
+    # fired (mask on row 0) when the combat cell-tables dropped entities
+    # this tick — a runtime signal that bucket sizing no longer matches
+    # density (params: dropped_victims / dropped_attackers counts)
+    ON_COMBAT_TABLE_OVERFLOW = 5
 
 
 class ItemType(enum.IntEnum):

@@ -93,10 +93,20 @@ class ScheduleModule(Module):
         interval_s: float,
         count: int = -1,
         start_delay_s: Optional[float] = None,
+        start_delay_ticks: Optional[np.ndarray] = None,
     ) -> WorldState:
+        """Batch-arm one timer slot.  `start_delay_ticks` (per-row int
+        array aligned with `rows`) staggers first firings — the batch
+        equivalent of the reference's per-object AddHeartBeat calls, whose
+        first firings spread naturally over object creation times."""
         slot = self.slot(class_name, timer_name)
         interval = self.ticks_of(interval_s)
-        delay = interval if start_delay_s is None else self.ticks_of(start_delay_s)
+        if start_delay_ticks is not None:
+            delay = np.maximum(np.asarray(start_delay_ticks, np.int32), 1)
+        elif start_delay_s is not None:
+            delay = self.ticks_of(start_delay_s)
+        else:
+            delay = interval
         cs = state.classes[class_name]
         t = cs.timers
         now = state.tick

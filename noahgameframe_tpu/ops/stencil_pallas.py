@@ -1,16 +1,19 @@
-"""Pallas TPU kernel for the combat stencil fold.
+"""Pallas TPU kernel for the combat stencil fold (split-table form).
 
-The XLA path (ops/stencil.py stencil_fold) walks the 3x3 neighborhood as
-nine shifted slices of the padded cell table — nine reads of the table
-from HBM, fused per shift.  This kernel makes the whole fold ONE pass:
-the grid iterates over cell rows, Pallas streams each row's three
-neighbor rows into VMEM (the same padded table is bound three times with
-block index maps y, y+1, y+2 — overlapping, read-only), and the nine
-shifted pairwise reductions run on-core against resident data.
+The XLA path (game/combat.py's fold over ops/stencil.stencil_fold) walks
+the 3x3 neighborhood as nine shifted slices of the padded attacker
+table — nine HBM passes over the candidate planes plus whatever
+intermediates XLA materializes for the [Kv, Ka] pairwise masks.  This
+kernel makes the whole fold ONE pass: the grid iterates over cell rows;
+each program holds the victim row's planes plus the three neighboring
+attacker rows in VMEM (the same padded attacker planes bound three times
+with block index maps y, y+1, y+2 — overlapping, read-only), and the
+nine shifted pairwise reductions run on-core against resident data.
 
-Layout: the table rides as [H+2, F, K, W+2] so the wide W axis lands on
-vector lanes and K on sublanes; per-program blocks are [1, F, K, W+2].
-Outputs are [H, 3, K, W] (incoming, best-atk, best-row planes).
+Layout: planes ride as [rows, F, K, W(+2)] so the wide W axis lands on
+vector lanes and K on sublanes.  Victims are resident (no padding, one
+mid-row ref); attackers are the scanned side (padded, three refs).
+Outputs are [H, 3, Kv, W] (incoming, best-atk, best-row planes).
 
 Semantics are identical to CombatModule's XLA fold (same stencil order,
 same tie-breaks) — pinned by tests/test_stencil_pallas.py, which runs
@@ -18,8 +21,9 @@ this kernel in interpret mode on CPU against the XLA path.  On real TPU
 hardware the kernel compiles natively; enable with NF_PALLAS=1 (opt-in
 until chip-time confirms a win over the already-fused XLA fold).
 
-Feature plane order (CombatModule's feats stack; the table's
-occupancy column is dropped — empty slots carry eff_atk 0 and mask out):
+Victim feature planes (CombatModule's vic_feats; occupancy dropped):
+    0: x   1: y   2: camp   3: scene   4: group   5: row
+Attacker feature planes (att_feats):
     0: x   1: y   2: eff_atk   3: camp   4: scene   5: group   6: row
 """
 
@@ -31,33 +35,36 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-F_X, F_Y, F_ATK, F_CAMP, F_SCENE, F_GROUP, F_ROW = range(7)
-N_FEATS = 7
+V_X, V_Y, V_CAMP, V_SCENE, V_GROUP, V_ROW = range(6)
+N_VFEATS = 6
+A_X, A_Y, A_ATK, A_CAMP, A_SCENE, A_GROUP, A_ROW = range(7)
+N_AFEATS = 7
 
 
-def _kernel(top_ref, mid_ref, bot_ref, out_ref, *, w: int, r2: float):
-    k = mid_ref.shape[2]
-    vx = mid_ref[0, F_X, :, 1 : w + 1]
-    vy = mid_ref[0, F_Y, :, 1 : w + 1]
-    vcamp = mid_ref[0, F_CAMP, :, 1 : w + 1]
-    vscene = mid_ref[0, F_SCENE, :, 1 : w + 1]
-    vgroup = mid_ref[0, F_GROUP, :, 1 : w + 1]
-    vrow = mid_ref[0, F_ROW, :, 1 : w + 1]
+def _kernel(vic_ref, top_ref, mid_ref, bot_ref, out_ref, *, w: int, r2: float):
+    kv = vic_ref.shape[2]
+    ka = top_ref.shape[2]
+    vx = vic_ref[0, V_X]
+    vy = vic_ref[0, V_Y]
+    vcamp = vic_ref[0, V_CAMP]
+    vscene = vic_ref[0, V_SCENE]
+    vgroup = vic_ref[0, V_GROUP]
+    vrow = vic_ref[0, V_ROW]
 
-    inc = jnp.zeros((k, w), jnp.int32)
-    besta = jnp.full((k, w), -1.0, jnp.float32)
-    bestr = jnp.full((k, w), -1.0, jnp.float32)
+    inc = jnp.zeros((kv, w), jnp.int32)
+    besta = jnp.full((kv, w), -1.0, jnp.float32)
+    bestr = jnp.full((kv, w), -1.0, jnp.float32)
 
     # stencil order (dy, dx) ascending — identical to ops.stencil.STENCIL
     for ref in (top_ref, mid_ref, bot_ref):
         for dx in (0, 1, 2):
-            cx = ref[0, F_X, :, dx : dx + w]
-            cy = ref[0, F_Y, :, dx : dx + w]
-            ca = ref[0, F_ATK, :, dx : dx + w]
-            cc = ref[0, F_CAMP, :, dx : dx + w]
-            cs = ref[0, F_SCENE, :, dx : dx + w]
-            cg = ref[0, F_GROUP, :, dx : dx + w]
-            cr = ref[0, F_ROW, :, dx : dx + w]
+            cx = ref[0, A_X, :, dx : dx + w]
+            cy = ref[0, A_Y, :, dx : dx + w]
+            ca = ref[0, A_ATK, :, dx : dx + w]
+            cc = ref[0, A_CAMP, :, dx : dx + w]
+            csc = ref[0, A_SCENE, :, dx : dx + w]
+            cg = ref[0, A_GROUP, :, dx : dx + w]
+            cr = ref[0, A_ROW, :, dx : dx + w]
             ddx = vx[:, None, :] - cx[None, :, :]
             ddy = vy[:, None, :] - cy[None, :, :]
             cab = ca[None, :, :]
@@ -65,7 +72,7 @@ def _kernel(top_ref, mid_ref, bot_ref, out_ref, *, w: int, r2: float):
                 (ddx * ddx + ddy * ddy <= r2)
                 & (cab != 0.0)
                 & (cc[None, :, :] != vcamp[:, None, :])
-                & (cs[None, :, :] == vscene[:, None, :])
+                & (csc[None, :, :] == vscene[:, None, :])
                 & (cg[None, :, :] == vgroup[:, None, :])
                 & (cr[None, :, :] != vrow[:, None, :])
             )
@@ -73,11 +80,11 @@ def _kernel(top_ref, mid_ref, bot_ref, out_ref, *, w: int, r2: float):
                 jnp.where(ok, cab, 0.0), axis=1
             ).astype(jnp.int32)
             sa = jnp.where(ok, cab, -1.0)
-            sa = jnp.broadcast_to(sa, (k, k, w))
+            sa = jnp.broadcast_to(sa, (kv, ka, w))
             m = jnp.max(sa, axis=1)
             first = jnp.min(
                 jnp.where(sa >= m[:, None, :],
-                          jnp.broadcast_to(cr[None, :, :], (k, k, w)),
+                          jnp.broadcast_to(cr[None, :, :], (kv, ka, w)),
                           jnp.inf),
                 axis=1,
             )
@@ -92,53 +99,61 @@ def _kernel(top_ref, mid_ref, bot_ref, out_ref, *, w: int, r2: float):
     out_ref[0, 2] = bestr
 
 
-def combat_fold_pallas(
-    table_planes: jnp.ndarray,
-    radius: float,
-    width: int,
-    interpret: bool = False,
-    bucket: int = 0,
-):
-    """table_planes: [H+2, F, Kpad, W+2] padded feature planes (f32,
-    from planes_from_table).  Returns (inc [H,W,K] int32, bestr
-    [H,W,K] int32) sliced back to `bucket` slots (0 = keep Kpad)."""
-    hp, f, k, wp = table_planes.shape
-    h = hp - 2
-    w = wp - 2
-    assert f == N_FEATS and w == width
-    row_spec = lambda off: pl.BlockSpec(  # noqa: E731
-        (1, f, k, wp), lambda y, o=off: (y + o, 0, 0, 0)
+def combat_fold_pallas(vic_table, att_table, radius: float, interpret: bool = False):
+    """Fused 3x3 stencil fold: victims resident, attackers scanned.
+
+    vic_table / att_table: ops.stencil.CellTable over the SAME grid
+    geometry (vic carries 6 feature cols, att 7 — see module docstring).
+    Returns (inc [H, W, Kv] int32, bestr [H, W, Kv] int32), matching the
+    XLA fold's outputs before `pull`."""
+    width = vic_table.width
+    assert att_table.width == width and att_table.cell_size == vic_table.cell_size
+    vic = _planes(vic_table.payload, width, vic_table.bucket, N_VFEATS, pad=False)
+    att = _planes(att_table.payload, width, att_table.bucket, N_AFEATS, pad=True)
+    h = width
+    w = width
+    kv = vic.shape[2]
+    ka = att.shape[2]
+    vic_spec = pl.BlockSpec((1, N_VFEATS, kv, w), lambda y: (y, 0, 0, 0))
+    att_spec = lambda off: pl.BlockSpec(  # noqa: E731
+        (1, N_AFEATS, ka, w + 2), lambda y, o=off: (y + o, 0, 0, 0)
     )
     out = pl.pallas_call(
         functools.partial(_kernel, w=w, r2=float(radius) * float(radius)),
         grid=(h,),
-        in_specs=[row_spec(0), row_spec(1), row_spec(2)],
-        out_specs=pl.BlockSpec((1, 3, k, w), lambda y: (y, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((h, 3, k, w), jnp.float32),
+        in_specs=[vic_spec, att_spec(0), att_spec(1), att_spec(2)],
+        out_specs=pl.BlockSpec((1, 3, kv, w), lambda y: (y, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((h, 3, kv, w), jnp.float32),
         interpret=interpret,
-    )(table_planes, table_planes, table_planes)
+    )(vic, att, att, att)
     inc = jax.lax.bitcast_convert_type(
         out[:, 0].transpose(0, 2, 1), jnp.int32
-    )  # [H, W, Kpad]
+    )  # [H, W, Kv]
     bestr = out[:, 2].transpose(0, 2, 1).astype(jnp.int32)
-    if bucket and bucket < k:
-        inc = inc[..., :bucket]
-        bestr = bestr[..., :bucket]
+    if kv > vic_table.bucket:
+        inc = inc[..., : vic_table.bucket]
+        bestr = bestr[..., : vic_table.bucket]
     return inc, bestr
 
 
-def planes_from_table(payload: jnp.ndarray, width: int, bucket: int) -> jnp.ndarray:
-    """CellTable payload [(H*W*K)+1, F+1] -> padded planes [H+2, F, K, W+2].
+def _planes(payload: jnp.ndarray, width: int, bucket: int, n_feats: int,
+            pad: bool) -> jnp.ndarray:
+    """CellTable payload [(H*W*K)+1, F+1] -> feature planes.
 
-    The occupancy column is dropped (the kernel masks empty slots via
-    eff_atk == 0); border cells pad with zeros so edge neighbors mask
-    out exactly like the XLA fold's zero padding.  K also pads up to a
-    multiple of 8 so the sublane axis stays tile-aligned on real TPUs
-    (pad slots are all-zero => eff_atk 0 => masked; the caller slices
-    the outputs back to the table's K)."""
+    pad=True (attacker side) adds the one-cell zero border the shifted
+    reads need: [H+2, F, K, W+2]; border slots are all-zero => eff_atk 0
+    => masked, exactly like the XLA fold's zero padding.  pad=False
+    (victim side, resident) gives [H, F, K, W].  K pads up to a multiple
+    of 8 so the sublane axis stays tile-aligned on real TPUs (pad slots
+    are all-zero; for victims the caller slices outputs back to K —
+    zero-slot victims never map back through `pull`)."""
     h = w = width
     k = bucket
-    v = payload[:-1, :N_FEATS].reshape(h, w, k, N_FEATS)
+    v = payload[:-1, :n_feats].reshape(h, w, k, n_feats)
     planes = v.transpose(0, 3, 2, 1)  # [H, F, K, W]
     k_pad = (-k) % 8
-    return jnp.pad(planes, ((1, 1), (0, 0), (0, k_pad), (1, 1)))
+    if pad:
+        return jnp.pad(planes, ((1, 1), (0, 0), (0, k_pad), (1, 1)))
+    if k_pad:
+        return jnp.pad(planes, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
+    return planes

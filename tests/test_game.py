@@ -155,6 +155,39 @@ def test_combat_kill_event_respawn():
     assert respawned and any(m.any() for m in respawned)
 
 
+def test_combat_overflow_event_fires():
+    """Bucket overflow must be observable at runtime, not only via
+    bench.py's offline replay: piling entities past the cell bucket
+    fires ON_COMBAT_TABLE_OVERFLOW with the drop counts."""
+    w = GameWorld(
+        WorldConfig(
+            npc_capacity=32, extent=32.0, aoe_radius=5.0,
+            attack_period_s=1.0 / 30.0, movement=False, regen=False,
+            middleware=False,
+        )
+    )
+    w.combat.bucket = 4  # force tiny cells: 12 stacked entities overflow
+    w.start()
+    w.scene.create_scene(1, width=32.0)
+    k = w.kernel
+    for i in range(12):
+        k.create_object(
+            "NPC", {"Position": (5.0, 5.0, 0.0), "Camp": i % 2, "HP": 100},
+            scene=1,
+        )
+    w.combat.arm_all()
+    seen = []
+    k.events.subscribe_batch(
+        int(GameEvent.ON_COMBAT_TABLE_OVERFLOW),
+        lambda c, m, p: seen.append((m.copy(), {k2: v.copy() for k2, v in p.items()})),
+    )
+    w.tick()
+    w.tick()
+    assert seen, "overflow event expected"
+    _, params = seen[-1]
+    assert int(params["dropped_victims"][0]) == 8  # 12 - bucket 4
+
+
 def test_regen_heals_to_cap(small_world):
     w = small_world
     g = w.kernel.create_object("NPC", {"HP": 10}, scene=1)

@@ -66,6 +66,73 @@ def test_auto_bucket_keeps_overflow_tiny_at_benchmark_density():
     assert int(t.dropped) <= n // 1000
 
 
+def test_attacker_bucket_stagger_keeps_drops_zero():
+    """Staggered arming puts ~duty*N attackers per tick in the candidate
+    table; the duty-scaled bucket must keep dropped attacks ~zero at
+    benchmark density, and synchronized arming must fall back to the
+    full-size bucket (no silent attack drops)."""
+    from noahgameframe_tpu.game import build_benchmark_world
+
+    n = 30_000
+    w = build_benchmark_world(n, seed=5)  # arm_all(stagger=True) inside
+    combat = w.combat
+    k = w.kernel
+    cap = k.state.classes["NPC"].alive.shape[0]
+    interval = k.schedule.ticks_of(combat.attack_period_s)
+    assert combat._attacker_duty == 1.0 / interval
+    k_att = combat.resolved_att_bucket(cap)
+    k_vic = combat.resolved_bucket(cap)
+    assert k_att < k_vic  # the candidate side actually shrank
+    # every firing residue of the attack timer must fit the bucket
+    spec = k.store.spec("NPC")
+    cs = k.state.classes["NPC"]
+    slot = k.schedule.slot("NPC", "Attack")
+    t = cs.timers
+    armed = np.asarray(t.active[:, slot] & cs.alive)
+    residue = np.asarray(t.next_fire[:, slot]) % interval
+    pos = cs.vec[:, spec.slot("Position").col, :2]
+    worst = 0
+    for p in range(interval):
+        mask = jnp.asarray(armed & (residue == p))
+        tab = build_cell_table(
+            pos, mask, jnp.zeros((cap, 0), jnp.float32),
+            combat.cell_size, combat.width, k_att,
+        )
+        worst = max(worst, int(tab.dropped))
+    assert worst == 0, worst
+    # synchronized arming: duty returns to 1.0 and the candidate bucket
+    # falls back to the victim bucket (everyone can fire at once)
+    combat.arm_all(stagger=False)
+    assert combat._attacker_duty == 1.0
+    assert combat.resolved_att_bucket(cap) == k_vic
+
+
+def test_stagger_preserves_dps_and_determinism():
+    """Staggered phases change WHEN each entity attacks, not how often:
+    over one full period every armed entity fires exactly once."""
+    from noahgameframe_tpu.game import GameWorld, WorldConfig
+
+    w = GameWorld(WorldConfig(npc_capacity=64, extent=32.0, movement=False,
+                              regen=False, middleware=False,
+                              attack_period_s=0.2))  # 6 ticks
+    w.start()
+    w.scene.create_scene(1, width=32.0)
+    w.seed_npcs(40, hp=10_000, atk=5)
+    k = w.kernel
+    interval = k.schedule.ticks_of(0.2)
+    cs = k.state.classes["NPC"]
+    slot = k.schedule.slot("NPC", "Attack")
+    # staggered first firings land on ticks 1..interval (delay = 1 +
+    # row % interval; tick t fires timers with next_fire <= t), so the
+    # window [0, interval] sees every armed entity fire exactly once
+    fired_total = np.zeros(cs.alive.shape[0], np.int64)
+    for _ in range(interval + 1):
+        out = k.tick()
+        fired_total += np.asarray(out.fired["NPC"][:, slot])
+    alive = np.asarray(k.state.classes["NPC"].alive)
+    np.testing.assert_array_equal(fired_total[alive], 1)
+
+
 def test_pull_roundtrip_and_fill():
     n = 100
     pos = jnp.asarray(rand_pos(n, 40.0, seed=1))

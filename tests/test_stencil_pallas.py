@@ -8,13 +8,13 @@ from noahgameframe_tpu.game import GameWorld, WorldConfig
 from noahgameframe_tpu.game.defines import PropertyGroup
 
 
-def build(n, seed, use_pallas):
+def build(n, seed, use_pallas, attack_period_s=1.0 / 30.0):
     rng = np.random.RandomState(seed)
     extent = 40.0
     w = GameWorld(
         WorldConfig(
             npc_capacity=256, extent=extent, aoe_radius=5.0,
-            attack_period_s=1.0 / 30.0, movement=True, regen=False,
+            attack_period_s=attack_period_s, movement=True, regen=False,
             middleware=False, seed=7,
         )
     )
@@ -53,3 +53,23 @@ def test_pallas_fold_matches_xla_fold(seed):
     va = np.asarray(a.kernel.state.classes["NPC"].vec)
     vb = np.asarray(b.kernel.state.classes["NPC"].vec)
     np.testing.assert_array_equal(va, vb)
+
+
+def test_pallas_fold_matches_xla_fold_asymmetric_buckets():
+    """Staggered arming makes the attacker bucket SMALLER than the victim
+    bucket (Ka < Kv) — the [Kv, Ka] pairwise broadcasts and tie-break
+    reductions must stay bit-identical in that regime, not just at
+    Ka == Kv."""
+    a = build(150, 23, use_pallas=False, attack_period_s=0.2)
+    b = build(150, 23, use_pallas=True, attack_period_s=0.2)
+    cap = a.kernel.state.classes["NPC"].alive.shape[0]
+    ka = a.combat.resolved_att_bucket(cap)
+    kv = a.combat.resolved_bucket(cap)
+    assert ka < kv, (ka, kv)
+    for _ in range(8):  # > one full 6-tick period: every phase fires
+        a.tick()
+        b.tick()
+    np.testing.assert_array_equal(
+        np.asarray(a.kernel.state.classes["NPC"].i32),
+        np.asarray(b.kernel.state.classes["NPC"].i32),
+    )
