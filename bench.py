@@ -170,7 +170,15 @@ def run_served(args) -> dict:
     from noahgameframe_tpu.net.wire import Ident, ident_key
 
     n = args.entities
-    world = build_benchmark_world(n, combat=not args.no_combat, seed=42)
+    # one live Player avatar per simulated session, + headroom (the
+    # driver's served probe seats 500 — round-2 weak #6 follow-up: the
+    # default 64-row Player bank made the probe crash at session 65)
+    world = build_benchmark_world(
+        n,
+        combat=not args.no_combat,
+        seed=42,
+        player_capacity=1 << max(6, int(args.sessions + 8).bit_length()),
+    )
     role = GameRole(
         RoleConfig(6, 0, "BenchGame", "127.0.0.1", 0),
         backend="py",

@@ -29,7 +29,12 @@ import jax.numpy as jnp
 from ..core.datatypes import Guid
 from ..core.store import HANDLE_ROW_BITS, WorldState, with_class
 from ..kernel.module import Module
-from ..ops.stencil import auto_bucket, build_cell_table, pull, stencil_fold
+from ..ops.stencil import (
+    auto_bucket,
+    build_cell_table_pair,
+    pull,
+    stencil_fold,
+)
 from .defines import GameEvent
 
 ATTACK_TIMER = "Attack"
@@ -187,16 +192,15 @@ class CombatModule(Module):
             [pos[:, 0], pos[:, 1], camp_f, scene_f, group_f, rows_f],
             axis=-1,
         )
-        vic_table = build_cell_table(
-            pos, cs.alive, vic_feats, self.cell_size, self.width, bucket
-        )
         eff_atk = jnp.where(attacking, atk, 0).astype(f32)
         att_feats = jnp.stack(
             [pos[:, 0], pos[:, 1], eff_atk, camp_f, scene_f, group_f, rows_f],
             axis=-1,
         )
-        att_table = build_cell_table(
-            pos, attacking, att_feats, self.cell_size, self.width, att_bucket
+        # one argsort feeds both tables (attackers are a subset of alive)
+        vic_table, att_table = build_cell_table_pair(
+            pos, cs.alive, vic_feats, attacking, att_feats,
+            self.cell_size, self.width, bucket, att_bucket,
         )
         pallas_on = self.use_pallas
         if pallas_on is None:

@@ -235,9 +235,12 @@ def build_benchmark_world(
     combat: bool = True,
     seed: int = 0,
     attack_period_s: float = 1.0,
+    player_capacity: int = 64,
 ) -> GameWorld:
     """The staged BASELINE configs: density held at ~0.4 NPCs per world
-    unit² so AOI cost scales with N, not with density."""
+    unit² so AOI cost scales with N, not with density.  `player_capacity`
+    sizes the Player bank for served-path runs (bench.py --served seats
+    one live avatar per simulated session)."""
     if extent is None:
         extent = max(64.0, float(np.sqrt(n_npcs / 0.4)))
     cap = 1 << int(np.ceil(np.log2(max(n_npcs, 64))))
@@ -249,6 +252,7 @@ def build_benchmark_world(
             seed=seed,
             attack_period_s=attack_period_s,
             middleware=False,
+            player_capacity=player_capacity,
         )
     )
     w.start()

@@ -34,6 +34,7 @@ from ...kernel.kernel import (
     REC_UPDATED,
     TickOutputs,
 )
+from ...utils.hostio import gather_rows
 from ...persist.codec import (
     record_row_struct,
     serialize_properties,
@@ -821,9 +822,9 @@ class GameRole(ServerRole):
         host = k.store._hosts[player_class]
         rows = np.flatnonzero(host.alloc_mask)
         if rows.size:
-            cols = np.asarray(
-                cs.i32[rows][:, [spec.slots["SceneID"].col,
-                                 spec.slots["GroupID"].col]]
+            cols = gather_rows(
+                cs.i32, rows,
+                cols=[spec.slots["SceneID"].col, spec.slots["GroupID"].col],
             )
             for r, (sc, gr) in zip(rows.tolist(), cols.tolist()):
                 g = host.row_guid[r]
@@ -851,9 +852,9 @@ class GameRole(ServerRole):
         k = self.kernel
         spec = k.store.spec(cname)
         cs = k.state.classes[cname]
-        return np.asarray(
-            cs.i32[rows][:, [spec.slots["SceneID"].col,
-                             spec.slots["GroupID"].col]]
+        return gather_rows(
+            cs.i32, rows,
+            cols=[spec.slots["SceneID"].col, spec.slots["GroupID"].col],
         )
 
     def _flush_changes(self) -> None:
@@ -902,8 +903,8 @@ class GameRole(ServerRole):
             key = (cname, bank.value)
             if key not in sub_cache:
                 cs = k.state.classes[cname]
-                sub_cache[key] = np.asarray(
-                    getattr(cs, bank.value)[rows_by_class[cname]]
+                sub_cache[key] = gather_rows(
+                    getattr(cs, bank.value), rows_by_class[cname]
                 )
             return sub_cache[key]
 
@@ -955,11 +956,11 @@ class GameRole(ServerRole):
         cells = self._rows_cells(cname, rows)  # [n, 2]
         cs = k.state.classes[cname]
         if slot.bank == Bank.VEC:
-            vals = np.asarray(cs.vec[rows, slot.col], np.float32)  # [n, 3]
+            vals = gather_rows(cs.vec, rows, cols=slot.col)[:, 0]  # [n, 3]
         elif slot.bank == Bank.F32:
-            vals = np.asarray(cs.f32[rows, slot.col], np.float32)
+            vals = gather_rows(cs.f32, rows, cols=slot.col)[:, 0]
         else:
-            vals = np.asarray(cs.i32[rows, slot.col], np.int32)
+            vals = gather_rows(cs.i32, rows, cols=slot.col)[:, 0]
         heads = host.guid_head[rows]
         datas = host.guid_data[rows]
         cell_ids = cells[:, 0].astype(np.int64) * MAX_GROUPS_PER_SCENE + cells[:, 1]

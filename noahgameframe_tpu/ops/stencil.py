@@ -99,27 +99,16 @@ def auto_bucket(capacity: int, width: int, lo: int = 8, hi: int = 256) -> int:
     return (k + 3) // 4 * 4
 
 
-def build_cell_table(
-    pos: jnp.ndarray,
-    active: jnp.ndarray,
-    features: jnp.ndarray,
-    cell_size: float,
-    width: int,
-    bucket: int,
-) -> CellTable:
-    """Bin `active` entities into the uniform grid, carrying `features`.
-
-    pos: [N, >=2] positions; active: [N] bool; features: [N, F] float32.
-    One argsort + one permutation-gather + one scatter; all slot indices
-    are unique so the scatter is deterministic.
-    """
+def _sorted_segments(pos, active, cell_size: float, width: int):
+    """Shared build prefix: the ONE stable argsort by cell id plus
+    per-element segment ranks.  Returns (n_cells, order, skey, seg_start,
+    rank) — everything both table builders derive slots from."""
     n = pos.shape[0]
     if n >= 1 << 24:
         # row ids (and other int-valued columns) ride in f32 payload
         # columns, exact only below 2^24 — refuse silent corruption
         raise ValueError(f"cell table capacity {n} >= 2^24 breaks f32 row ids")
     n_cells = width * width
-    dump = n_cells * bucket
     cell = cell_of(pos, cell_size, width)
     key = jnp.where(active, cell, n_cells)
     order = jnp.argsort(key)  # stable: preserves row order within a cell
@@ -131,6 +120,17 @@ def build_cell_table(
     # index of each sorted element's segment head, via running max
     start_idx = jax.lax.cummax(jnp.where(seg_start, idx, 0))
     rank = idx - start_idx
+    return n_cells, order, skey, seg_start, rank
+
+
+def _finish_table(
+    features, active, n_cells: int, order, skey, rank,
+    cell_size: float, width: int, bucket: int,
+) -> CellTable:
+    """Shared build suffix: slots from ranks, ONE deterministic scatter
+    (unique slot indices), dump-slot zeroing, drop count."""
+    n = features.shape[0]
+    dump = n_cells * bucket
     placed = (rank < bucket) & (skey < n_cells)
     flat_sorted = jnp.where(placed, skey * bucket + rank, dump)
     # un-sort the slot assignment, then scatter features from ROW order —
@@ -149,6 +149,68 @@ def build_cell_table(
     payload = payload.at[dump].set(0.0)
     dropped = jnp.sum(active & (slot_of == dump), dtype=jnp.int32)
     return CellTable(payload, slot_of, dropped, width, cell_size, bucket)
+
+
+def build_cell_table(
+    pos: jnp.ndarray,
+    active: jnp.ndarray,
+    features: jnp.ndarray,
+    cell_size: float,
+    width: int,
+    bucket: int,
+) -> CellTable:
+    """Bin `active` entities into the uniform grid, carrying `features`.
+
+    pos: [N, >=2] positions; active: [N] bool; features: [N, F] float32.
+    One argsort + one permutation-gather + one scatter; all slot indices
+    are unique so the scatter is deterministic.
+    """
+    n_cells, order, skey, _seg_start, rank = _sorted_segments(
+        pos, active, cell_size, width
+    )
+    return _finish_table(
+        features, active, n_cells, order, skey, rank, cell_size, width, bucket
+    )
+
+
+def build_cell_table_pair(
+    pos: jnp.ndarray,
+    active: jnp.ndarray,
+    features: jnp.ndarray,
+    sub_mask: jnp.ndarray,
+    sub_features: jnp.ndarray,
+    cell_size: float,
+    width: int,
+    bucket: int,
+    sub_bucket: int,
+) -> Tuple[CellTable, CellTable]:
+    """Build the full table AND a subset table from ONE argsort.
+
+    `sub_mask` must be a subset of `active` (combat: attackers among all
+    alive entities).  Placement is bit-identical to two independent
+    `build_cell_table` calls — within a cell both tables hold rows in
+    ascending order, and the subset ranks are the subset's own ordinal
+    positions — but the second sort and its key gather are replaced by a
+    segmented cumsum over the shared sorted order."""
+    n_cells, order, skey, seg_start, rank = _sorted_segments(
+        pos, active, cell_size, width
+    )
+    full = _finish_table(
+        features, active, n_cells, order, skey, rank, cell_size, width, bucket
+    )
+    # subset ranks via segmented exclusive cumsum: ex is non-decreasing,
+    # so "ex at my segment's head" is a cummax over heads — no gather.
+    # Non-members get an out-of-range rank so _finish_table sends them
+    # to the dump slot.
+    sub_sorted = sub_mask[order]
+    ex = jnp.cumsum(sub_sorted.astype(jnp.int32)) - sub_sorted.astype(jnp.int32)
+    head_ex = jax.lax.cummax(jnp.where(seg_start, ex, -1))
+    sub_rank = jnp.where(sub_sorted, ex - head_ex, n_cells * sub_bucket + 1)
+    sub = _finish_table(
+        sub_features, sub_mask, n_cells, order, skey, sub_rank,
+        cell_size, width, sub_bucket,
+    )
+    return full, sub
 
 
 def stencil_fold(

@@ -66,6 +66,37 @@ def test_auto_bucket_keeps_overflow_tiny_at_benchmark_density():
     assert int(t.dropped) <= n // 1000
 
 
+def test_pair_build_matches_independent_builds():
+    """build_cell_table_pair must place both tables bit-identically to
+    two independent build_cell_table calls (same slots, same payloads,
+    same drop counts) — including under subset overflow."""
+    from noahgameframe_tpu.ops.stencil import build_cell_table_pair
+
+    n = 4000
+    rng = np.random.RandomState(3)
+    pos = jnp.asarray(rng.uniform(0, 100.0, (n, 2)).astype(np.float32))
+    active = jnp.asarray(rng.rand(n) < 0.9)
+    sub = active & jnp.asarray(rng.rand(n) < 0.2)
+    feats = jnp.asarray(rng.randn(n, 3).astype(np.float32))
+    sub_feats = jnp.asarray(rng.randn(n, 2).astype(np.float32))
+    for kv, ka in ((16, 4), (16, 2)):  # ka=2 forces subset overflow
+        vt, at = build_cell_table_pair(
+            pos, active, feats, sub, sub_feats, 5.0, 20, kv, ka
+        )
+        vt2 = build_cell_table(pos, active, feats, 5.0, 20, kv)
+        at2 = build_cell_table(pos, sub, sub_feats, 5.0, 20, ka)
+        np.testing.assert_array_equal(np.asarray(vt.payload), np.asarray(vt2.payload))
+        np.testing.assert_array_equal(np.asarray(vt.slot_of), np.asarray(vt2.slot_of))
+        assert int(vt.dropped) == int(vt2.dropped)
+        np.testing.assert_array_equal(np.asarray(at.payload), np.asarray(at2.payload))
+        assert int(at.dropped) == int(at2.dropped)
+        # subset slot assignment must agree for member rows
+        mem = np.asarray(sub)
+        np.testing.assert_array_equal(
+            np.asarray(at.slot_of)[mem], np.asarray(at2.slot_of)[mem]
+        )
+
+
 def test_attacker_bucket_stagger_keeps_drops_zero():
     """Staggered arming puts ~duty*N attackers per tick in the candidate
     table; the duty-scaled bucket must keep dropped attacks ~zero at
