@@ -173,11 +173,13 @@ def run_served(args) -> dict:
     # one live Player avatar per simulated session, + headroom (the
     # driver's served probe seats 500 — round-2 weak #6 follow-up: the
     # default 64-row Player bank made the probe crash at session 65)
+    from noahgameframe_tpu.core.datatypes import next_pow2
+
     world = build_benchmark_world(
         n,
         combat=not args.no_combat,
         seed=42,
-        player_capacity=1 << max(6, int(args.sessions + 8).bit_length()),
+        player_capacity=next_pow2(args.sessions + 8, lo=64),
     )
     role = GameRole(
         RoleConfig(6, 0, "BenchGame", "127.0.0.1", 0),

@@ -34,6 +34,15 @@ from typing import Any, Tuple, Union
 import numpy as np
 
 
+def next_pow2(n: int, lo: int = 1) -> int:
+    """Smallest power of two >= max(n, lo) — the shape-bucketing unit
+    shared by host gathers (utils.hostio), batched row creation
+    (core.store), and bench sizing.  Lives here (dependency-free leaf)
+    so both core and utils can import it without cycles."""
+    n = max(int(n), int(lo), 1)
+    return 1 << (n - 1).bit_length()
+
+
 class DataType(enum.IntEnum):
     """Mirrors the reference TDATA_TYPE enum (NFIDataList.h:37-47)."""
 

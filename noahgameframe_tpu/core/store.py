@@ -52,6 +52,7 @@ from .datatypes import (
     Value,
     coerce,
     default_value,
+    next_pow2,
 )
 from .schema import ClassRegistry, ClassSpec, RecordSpec
 from .strings import StringTable
@@ -138,6 +139,38 @@ class WorldState:
     classes: Dict[str, ClassState]
     tick: jnp.ndarray  # int32 scalar
     rng: jnp.ndarray  # PRNG key
+
+
+@jax.jit
+def _reset_and_write_rows(cs: ClassState, rows, i32, f32, vec) -> ClassState:
+    """One compiled row-(re)initialization: value banks from the staged
+    payloads, timers disarmed, records cleared, alive on.  Cached per
+    (class pytree structure, row-count bucket) — the host enter-game path
+    calls this once per create instead of ~15 eager scatters."""
+    t = cs.timers
+    timers = TimerState(
+        next_fire=t.next_fire.at[rows].set(0),
+        interval=t.interval.at[rows].set(1),
+        remain=t.remain.at[rows].set(0),
+        active=t.active.at[rows].set(False),
+    )
+    records = {
+        rname: RecordState(
+            i32=rec.i32.at[rows].set(0),
+            f32=rec.f32.at[rows].set(0.0),
+            vec=rec.vec.at[rows].set(0.0),
+            used=rec.used.at[rows].set(False),
+        )
+        for rname, rec in cs.records.items()
+    }
+    return cs.replace(
+        i32=cs.i32.at[rows].set(i32) if cs.i32.shape[1] else cs.i32,
+        f32=cs.f32.at[rows].set(f32) if cs.f32.shape[1] else cs.f32,
+        vec=cs.vec.at[rows].set(vec) if cs.vec.shape[1] else cs.vec,
+        alive=cs.alive.at[rows].set(True),
+        timers=timers,
+        records=records,
+    )
 
 
 @dataclasses.dataclass
@@ -432,31 +465,27 @@ class EntityStore:
         host.guid_data[rows] = np.fromiter((g.data for g in out_guids), np.int64, n)
 
         cs = state.classes[class_name]
-        # fully reset the rows: banks to defaults/overrides, timers off, and
-        # every record cleared — recycled rows must not leak the previous
-        # entity's records or heartbeat schedule.
-        t = cs.timers
-        timers = TimerState(
-            next_fire=t.next_fire.at[rows].set(0),
-            interval=t.interval.at[rows].set(1),
-            remain=t.remain.at[rows].set(0),
-            active=t.active.at[rows].set(False),
-        )
-        records = {}
-        for rname, rec in cs.records.items():
-            records[rname] = RecordState(
-                i32=rec.i32.at[rows].set(0),
-                f32=rec.f32.at[rows].set(0.0),
-                vec=rec.vec.at[rows].set(0.0),
-                used=rec.used.at[rows].set(False),
-            )
-        cs = cs.replace(
-            i32=cs.i32.at[rows].set(i32) if spec.n_i32 else cs.i32,
-            f32=cs.f32.at[rows].set(f32) if spec.n_f32 else cs.f32,
-            vec=cs.vec.at[rows].set(vec) if spec.n_vec else cs.vec,
-            alive=cs.alive.at[rows].set(True),
-            timers=timers,
-            records=records,
+        # Fully reset the rows in ONE compiled call (banks to
+        # defaults/overrides, timers off, every record cleared — recycled
+        # rows must not leak the previous entity's records or heartbeat
+        # schedule).  The row index and payloads pad to a power-of-2
+        # bucket (repeating row 0 — idempotent duplicate writes) so
+        # enter-game-sized creates reuse a cached executable instead of
+        # dispatching ~15 eager scatters per object.
+        if n == 0:
+            return state, out_guids, rows
+        m = next_pow2(n)
+        if m != n:
+            pad = m - n
+            rows_p = np.concatenate([rows, np.repeat(rows[:1], pad)])
+            i32 = np.concatenate([i32, np.repeat(i32[:1], pad, 0)])
+            f32 = np.concatenate([f32, np.repeat(f32[:1], pad, 0)])
+            vec = np.concatenate([vec, np.repeat(vec[:1], pad, 0)])
+        else:
+            rows_p = rows
+        cs = _reset_and_write_rows(
+            cs, jnp.asarray(rows_p), jnp.asarray(i32), jnp.asarray(f32),
+            jnp.asarray(vec),
         )
         return with_class(state, class_name, cs), out_guids, rows
 

@@ -22,6 +22,9 @@ import jax.numpy as jnp
 import numpy as np
 
 
+from ..core.datatypes import next_pow2  # noqa: F401  (re-export)
+
+
 @jax.jit
 def _take0(arr, idx):
     return jnp.take(arr, idx, axis=0, mode="clip")
@@ -47,7 +50,7 @@ def gather_rows(arr, rows: np.ndarray, cols=None) -> np.ndarray:
             c = np.atleast_1d(np.asarray(cols))
             shape = (0, c.size) + tuple(arr.shape[2:])
         return np.empty(shape, dtype=np.dtype(arr.dtype))
-    m = 1 << (n - 1).bit_length()
+    m = next_pow2(n)
     idx = np.zeros(m, np.int32)
     idx[:n] = rows
     if cols is None:
