@@ -188,8 +188,13 @@ class CombatModule(Module):
         camp_f = camp.astype(f32)
         scene_f = cs.i32[:, spec.slot("SceneID").col].astype(f32)
         group_f = cs.i32[:, spec.slot("GroupID").col].astype(f32)
+        # no explicit self-exclusion column: an entity always shares its
+        # own camp, so the no-friendly-fire mask (cc != vcamp) already
+        # rules self out of every pair.  (If friendly fire is ever
+        # enabled, reintroduce a row compare here AND in the Pallas
+        # kernel.)
         vic_feats = jnp.stack(
-            [pos[:, 0], pos[:, 1], camp_f, scene_f, group_f, rows_f],
+            [pos[:, 0], pos[:, 1], camp_f, scene_f, group_f],
             axis=-1,
         )
         eff_atk = jnp.where(attacking, atk, 0).astype(f32)
@@ -223,9 +228,7 @@ class CombatModule(Module):
         else:
             v = vic_table.grid_view()
             vx, vy = v[..., 0], v[..., 1]
-            vcamp, vscene, vgroup, vrow = (
-                v[..., 2], v[..., 3], v[..., 4], v[..., 5]
-            )
+            vcamp, vscene, vgroup = v[..., 2], v[..., 3], v[..., 4]
             r2 = self.radius * self.radius
             idt = jnp.int32
 
@@ -243,10 +246,9 @@ class CombatModule(Module):
                 ok = (
                     (dx * dx + dy * dy <= r2)
                     & (ca != 0)  # a real attacker (empty slots carry 0)
-                    & (cc != vcamp[..., None])  # no friendly fire
+                    & (cc != vcamp[..., None])  # no friendly fire (also self)
                     & (cscene == vscene[..., None])  # same scene...
                     & (cgroup == vgroup[..., None])  # ...and group
-                    & (cr != vrow[..., None])  # not self
                 )
                 inc = inc + jnp.sum(jnp.where(ok, ca, 0.0), axis=-1).astype(idt)
                 # strongest attacker; ties resolve to the first candidate

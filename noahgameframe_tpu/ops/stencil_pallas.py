@@ -22,9 +22,11 @@ hardware the kernel compiles natively; enable with NF_PALLAS=1 (opt-in
 until chip-time confirms a win over the already-fused XLA fold).
 
 Victim feature planes (CombatModule's vic_feats; occupancy dropped):
-    0: x   1: y   2: camp   3: scene   4: group   5: row
+    0: x   1: y   2: camp   3: scene   4: group
 Attacker feature planes (att_feats):
     0: x   1: y   2: eff_atk   3: camp   4: scene   5: group   6: row
+(no self-exclusion compare: self always shares its own camp, so the
+no-friendly-fire mask rules self out — keep in sync with CombatModule)
 """
 
 from __future__ import annotations
@@ -35,8 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-V_X, V_Y, V_CAMP, V_SCENE, V_GROUP, V_ROW = range(6)
-N_VFEATS = 6
+V_X, V_Y, V_CAMP, V_SCENE, V_GROUP = range(5)
+N_VFEATS = 5
 A_X, A_Y, A_ATK, A_CAMP, A_SCENE, A_GROUP, A_ROW = range(7)
 N_AFEATS = 7
 
@@ -49,7 +51,6 @@ def _kernel(vic_ref, top_ref, mid_ref, bot_ref, out_ref, *, w: int, r2: float):
     vcamp = vic_ref[0, V_CAMP]
     vscene = vic_ref[0, V_SCENE]
     vgroup = vic_ref[0, V_GROUP]
-    vrow = vic_ref[0, V_ROW]
 
     inc = jnp.zeros((kv, w), jnp.int32)
     besta = jnp.full((kv, w), -1.0, jnp.float32)
@@ -74,7 +75,6 @@ def _kernel(vic_ref, top_ref, mid_ref, bot_ref, out_ref, *, w: int, r2: float):
                 & (cc[None, :, :] != vcamp[:, None, :])
                 & (csc[None, :, :] == vscene[:, None, :])
                 & (cg[None, :, :] == vgroup[:, None, :])
-                & (cr[None, :, :] != vrow[:, None, :])
             )
             inc = inc + jnp.sum(
                 jnp.where(ok, cab, 0.0), axis=1
@@ -103,7 +103,7 @@ def combat_fold_pallas(vic_table, att_table, radius: float, interpret: bool = Fa
     """Fused 3x3 stencil fold: victims resident, attackers scanned.
 
     vic_table / att_table: ops.stencil.CellTable over the SAME grid
-    geometry (vic carries 6 feature cols, att 7 — see module docstring).
+    geometry (vic carries 5 feature cols, att 7 — see module docstring).
     Returns (inc [H, W, Kv] int32, bestr [H, W, Kv] int32), matching the
     XLA fold's outputs before `pull`."""
     width = vic_table.width

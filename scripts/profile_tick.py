@@ -92,7 +92,7 @@ def main() -> None:
         n = pos.shape[0]
         bucket = combat.resolved_bucket(n)
         att_bucket = combat.resolved_att_bucket(n)
-        vic_feats = jnp.zeros((n, 6), jnp.float32)
+        vic_feats = jnp.zeros((n, 5), jnp.float32)
         att_feats = jnp.zeros((n, 7), jnp.float32)
         att_mask = cs.alive & (jnp.arange(n) % 30 == 0)  # ~one residue class
 
