@@ -18,6 +18,7 @@ from .items import EquipModule, ItemModule, PackModule
 from .level import LevelModule
 from .task import TaskDef, TaskModule
 from .movement import MovementModule
+from .scene_process import SCENE_TYPE_CLONE, SCENE_TYPE_NORMAL, SceneProcessModule
 from .property_config import PropertyConfigModule
 from .regen import REGEN_TIMER, RegenModule
 from .schema import standard_registry
@@ -60,6 +61,9 @@ __all__ = [
     "GameWorld",
     "LevelModule",
     "MovementModule",
+    "SceneProcessModule",
+    "SCENE_TYPE_CLONE",
+    "SCENE_TYPE_NORMAL",
     "NpcType",
     "PropertyConfigModule",
     "PropertyGroup",

@@ -39,6 +39,7 @@ from .task import TaskModule
 from .defines import COMM_PROPERTY_RECORD, PropertyGroup, STAT_NAMES
 from .level import LevelModule
 from .movement import MovementModule
+from .scene_process import SCENE_TYPE_CLONE, SCENE_TYPE_NORMAL, SceneProcessModule  # noqa: F401
 from .property_config import PropertyConfigModule
 from .regen import RegenModule
 from .schema import standard_registry
@@ -89,12 +90,13 @@ class GameWorld:
             diff_flags=cfg.diff_flags,
         )
         self.scene = SceneModule()
+        self.scene_process = SceneProcessModule(self.scene)
         self.components = ComponentModule()
         self.property_config = PropertyConfigModule()
         self.properties = PropertyModule()
         self.level = LevelModule(self.property_config, self.properties)
         self.skills = SkillModule()
-        modules = [self.kernel, self.scene, self.components, self.property_config, self.properties, self.level, self.skills]
+        modules = [self.kernel, self.scene, self.scene_process, self.components, self.property_config, self.properties, self.level, self.skills]
         self.pack = self.items = self.equip = self.heroes = self.tasks = None
         self.buffs = self.team = self.mail = self.rank = self.shop = None
         self.friends = self.guilds = self.gm = self.pvp = None

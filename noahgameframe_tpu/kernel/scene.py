@@ -57,6 +57,9 @@ class SceneInfo:
     groups: Dict[int, GroupInfo] = dataclasses.field(default_factory=dict)
     next_group: int = 1
     width: float = 512.0  # world extent, used by spatial AOI grids
+    # released group ids, recycled by request_group — clone scenes churn
+    # an instance per enter, and the id space is MAX_GROUPS_PER_SCENE
+    free_groups: List[int] = dataclasses.field(default_factory=list)
 
 
 class SceneModule(Module):
@@ -88,14 +91,31 @@ class SceneModule(Module):
         info.groups[0] = GroupInfo(0)
         return info
 
-    def request_group(self, scene_id: int, seed_npcs: bool = True) -> int:
-        """Allocate a fresh group in a scene and seed its NPCs (reference
-        RequestGroupScene)."""
+    def request_group(
+        self, scene_id: int, seed_npcs: bool = True,
+        group_id: Optional[int] = None,
+    ) -> int:
+        """Allocate a group in a scene and seed its NPCs (reference
+        RequestGroupScene).  With `group_id` the caller picks the id (it
+        must be free); otherwise released ids are recycled before fresh
+        ones are minted."""
         info = self.scenes[scene_id]
-        gid = info.next_group
-        info.next_group += 1
-        if gid >= MAX_GROUPS_PER_SCENE:
-            raise RuntimeError(f"scene {scene_id} group ids exhausted")
+        if group_id is not None:
+            gid = int(group_id)
+            if gid <= 0 or gid >= MAX_GROUPS_PER_SCENE:
+                raise ValueError(f"group id {gid} out of range")
+            if gid in info.groups:
+                raise ValueError(f"group {gid} already exists in scene {scene_id}")
+            if gid in info.free_groups:
+                info.free_groups.remove(gid)
+            info.next_group = max(info.next_group, gid + 1)
+        elif info.free_groups:
+            gid = info.free_groups.pop()
+        else:
+            gid = info.next_group
+            info.next_group += 1
+            if gid >= MAX_GROUPS_PER_SCENE:
+                raise RuntimeError(f"scene {scene_id} group ids exhausted")
         group = GroupInfo(gid)
         info.groups[gid] = group
         if seed_npcs:
@@ -116,12 +136,14 @@ class SceneModule(Module):
         """Destroy a group and everything in it; returns destroyed count
         (reference ReleaseGroupScene)."""
         info = self.scenes[scene_id]
-        info.groups.pop(group_id, None)
+        existed = info.groups.pop(group_id, None) is not None
         n = 0
         for class_name in self.kernel.store.class_order:
             for guid in self.objects_in_group(scene_id, group_id, class_name):
                 self.kernel.destroy_object(guid)
                 n += 1
+        if existed and group_id not in info.free_groups:
+            info.free_groups.append(group_id)
         return n
 
     # -- enter / leave choreography ----------------------------------------

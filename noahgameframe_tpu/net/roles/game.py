@@ -388,8 +388,9 @@ class GameRole(ServerRole):
         if not loaded:
             gw.properties.full_hp_mp(guid)
             gw.properties.full_sp(guid)
-        # enter-scene pipeline (RequestEnterScene semantics)
-        self.scene.enter_scene(guid, self.scene_id, 1)
+        # enter-scene pipeline (RequestEnterScene semantics; clone scenes
+        # mint a private instance via SceneProcessModule)
+        self._enter_scene(guid, self.scene_id)
         ack = AckEventResult(
             event_code=int(EventCode.ENTER_GAME_SUCCESS),
             event_object=guid_ident(guid),
@@ -507,17 +508,26 @@ class GameRole(ServerRole):
         )
 
     # ------------------------------------------------------------ gameplay
+    def _enter_scene(self, guid, scene_id: int, group: int = 1) -> int:
+        """Enter routed by scene type (NFCSceneProcessModule semantics):
+        clone scenes mint a private instance for the enterer, normal
+        scenes share `group` (created on first use)."""
+        if scene_id not in self.scene.scenes:
+            self.scene.create_scene(scene_id)
+        sp = getattr(self.game_world, "scene_process", None)
+        if sp is not None:
+            return sp.enter(guid, scene_id, group)
+        if group not in self.scene.scenes[scene_id].groups:
+            self.scene.request_group(scene_id, group_id=group)
+        self.scene.enter_scene(guid, scene_id, group)
+        return group
+
     def _on_swap_scene(self, conn_id: int, _msg_id: int, body: bytes) -> None:
         base, req = unwrap(body, ReqAckSwapScene)
         sess = self.sessions.get(_ident_key(base.player_id))
         if sess is None or sess.guid is None:
             return
-        scene_id = req.scene_id
-        if scene_id not in self.scene.scenes:
-            self.scene.create_scene(scene_id)
-        if 1 not in self.scene.scenes[scene_id].groups:
-            self.scene.request_group(scene_id)
-        self.scene.enter_scene(sess.guid, scene_id, 1)
+        self._enter_scene(sess.guid, req.scene_id)
         self._send_to_session(sess, MsgID.ACK_SWAP_SCENE, req)
 
     def _on_move(self, conn_id: int, _msg_id: int, body: bytes) -> None:

@@ -82,6 +82,15 @@ def base_registry() -> ClassRegistry:
             ],
         )
     )
+    reg.define(
+        ClassDef(
+            name="Scene",
+            properties=[
+                prop("SceneName", "string"),
+                prop("SceneType", "int"),  # normal vs clone
+            ],
+        )
+    )
     return reg
 
 

@@ -304,3 +304,34 @@ def test_wrong_connect_key_rejected(cluster):
     drive_client(cluster, c, lambda: not c.connected)
     assert not c.key_verified
     c.close()
+
+
+def test_game_role_clone_scene_routing():
+    """ReqSwapScene/enter-game route through SceneProcessModule: a scene
+    configured SceneType=CLONE mints a private instance per enterer on
+    the SERVER path, not just via the module API."""
+    from noahgameframe_tpu.game.scene_process import SCENE_TYPE_CLONE
+    from noahgameframe_tpu.net.roles.base import RoleConfig
+    from noahgameframe_tpu.net.roles.game import GameRole
+
+    role = GameRole(
+        RoleConfig(6, 0, "CloneGame", "127.0.0.1", 0),
+        backend="py",
+        world=GameWorld(WorldConfig(combat=False, movement=False,
+                                    regen=False, middleware=False)).start(),
+        cross_server_sync=False,
+    )
+    k = role.kernel
+    k.elements.add_element("Scene", "9", {"SceneType": SCENE_TYPE_CLONE})
+    a = k.create_object("Player", scene=1, group=0)
+    b = k.create_object("Player", scene=1, group=0)
+    ga = role._enter_scene(a, 9)
+    gb = role._enter_scene(b, 9)
+    assert ga != gb  # private instances
+    # normal scene: shared default group; leaving the clone scene
+    # releases the leaver's instance (and only theirs)
+    assert role._enter_scene(a, 5) == 1
+    assert ga not in role.scene.scenes[9].groups
+    assert gb in role.scene.scenes[9].groups
+    assert role._enter_scene(b, 5) == 1
+    assert gb not in role.scene.scenes[9].groups

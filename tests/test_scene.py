@@ -137,3 +137,41 @@ def test_enter_unknown_scene_rejected():
         scene.enter_scene(p, 99, 0)
     with pytest.raises(KeyError):
         scene.enter_scene(p, 1, 42)
+
+
+def test_scene_process_normal_vs_clone():
+    """NFCSceneProcessModule parity: normal scenes share group 1; clone
+    scenes mint a private group per enterer and release it when the
+    owner is destroyed (NFCSceneProcessModule.cpp:74-134)."""
+    from noahgameframe_tpu.game.scene_process import (
+        SCENE_TYPE_CLONE,
+        SceneProcessModule,
+    )
+
+    pm, kernel, scene = build_pm()
+    sp = SceneProcessModule(scene)
+    pm.register_plugin(Plugin("SceneProcessPlugin", [sp]))
+    pm.start()
+    scene.create_scene(1)
+    scene.create_scene(7)
+    # scene 7 is configured as a clone scene via its config element
+    kernel.elements.add_element("Scene", "7", {"SceneType": SCENE_TYPE_CLONE})
+
+    a = kernel.create_object("Player")
+    b = kernel.create_object("Player")
+    # normal scene: both land in the shared group
+    ga = sp.enter(a, 1)
+    gb = sp.enter(b, 1)
+    assert ga == gb == 1
+    # clone scene: private instances
+    ca = sp.enter(a, 7)
+    cb = sp.enter(b, 7)
+    assert ca != cb
+    assert ca in scene.scenes[7].groups and cb in scene.scenes[7].groups
+    # owner destroy releases the instance
+    kernel.destroy_object(a)
+    assert ca not in scene.scenes[7].groups
+    assert cb in scene.scenes[7].groups
+    # re-entering a clone scene swaps the old instance for a fresh one
+    cb2 = sp.enter(b, 7)
+    assert cb2 != cb and cb not in scene.scenes[7].groups
