@@ -18,6 +18,7 @@ REPO = Path(__file__).resolve().parent.parent
     "tutorial3_heartbeat_events.py",
     "tutorial4_actor.py",
     "tutorial5_sharded_world.py",
+    "tutorial6_cluster.py",
 ])
 def test_tutorial_runs(script):
     r = subprocess.run(
