@@ -137,8 +137,15 @@ class CombatModule(Module):
         shrinks ~duty-fold while victims stay fully resident."""
         import math
 
+        if self._attacker_duty >= 1.0:
+            # synchronized arming: everyone can fire on one tick — the
+            # candidate table must be exactly as deep as the victim table
+            return self.resolved_bucket(capacity)
         eff = max(1, int(math.ceil(capacity * self._attacker_duty)))
-        return min(auto_bucket(eff, self.width, lo=4), self.resolved_bucket(capacity))
+        return min(
+            auto_bucket(eff, self.width, lo=4, align=2),
+            self.resolved_bucket(capacity),
+        )
 
     # -- device phases -------------------------------------------------------
 

@@ -81,13 +81,18 @@ class CellTable(NamedTuple):
         return self.payload[:-1].reshape(h, w, k, self.payload.shape[-1])
 
 
-def auto_bucket(capacity: int, width: int, lo: int = 8, hi: int = 256) -> int:
+def auto_bucket(
+    capacity: int, width: int, lo: int = 8, hi: int = 256, align: int = 4
+) -> int:
     """Pick K so uniform occupancy ~Poisson(capacity/cells) stays under
     the overflow budget: mean + 2.5*sqrt(mean) + 2, rounded up to a
-    multiple of 4 within [lo, hi].  Fold cost scales with K^2, so the
-    margin is the thinnest that keeps expected drops well below 0.1% of
-    entities (capacity already overstates live density by up to 2x,
+    multiple of `align` within [lo, hi].  Fold cost scales with K^2, so
+    the margin is the thinnest that keeps expected drops well below 0.1%
+    of entities (capacity already overstates live density by up to 2x,
     which is extra headroom; the bound is pinned by tests/test_stencil.py).
+    Sparse candidate tables (the combat attacker side) pass align=2 —
+    at occupancy ~0.2/cell the rounding from 6 to 8 alone would cost
+    +33% fold work.
 
     Entities beyond a cell's K slots are dropped from that query (counted
     in CellTable.dropped) — they neither see nor are seen by neighbors
@@ -96,7 +101,7 @@ def auto_bucket(capacity: int, width: int, lo: int = 8, hi: int = 256) -> int:
     lam = capacity / float(max(width * width, 1))
     k = int(math.ceil(lam + 2.5 * math.sqrt(max(lam, 1.0)) + 2.0))
     k = max(lo, min(hi, k))
-    return (k + 3) // 4 * 4
+    return -(-k // align) * align
 
 
 def _sorted_segments(pos, active, cell_size: float, width: int):

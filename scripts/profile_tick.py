@@ -39,7 +39,17 @@ def main() -> None:
     ap.add_argument("--entities", type=int, default=1_000_000)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--no-combat", action="store_true")
+    ap.add_argument(
+        "--platform", choices=("default", "cpu"), default="default",
+        help="cpu: force the CPU backend in-process (the sitecustomize "
+        "overrides JAX_PLATFORMS env at startup, so the env var alone "
+        "cannot)",
+    )
     args = ap.parse_args()
+    if args.platform == "cpu":
+        from noahgameframe_tpu.utils.platform import force_cpu
+
+        force_cpu()
 
     from noahgameframe_tpu.game import build_benchmark_world
     from noahgameframe_tpu.kernel.kernel import TickCtx
