@@ -505,6 +505,18 @@ def main() -> None:
 
     probe_note = None
     if args.sharded:
+        if args.served:
+            _emit(
+                {
+                    "metric": "sharded_entity_ticks_per_sec",
+                    "value": 0.0,
+                    "unit": "entity-ticks/s",
+                    "vs_baseline": 0.0,
+                    "error": "--sharded measures the fused device loop; "
+                             "combine with --served is not supported",
+                }
+            )
+            return
         if args.platform == "tpu":
             _emit(
                 {

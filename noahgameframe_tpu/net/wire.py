@@ -184,7 +184,9 @@ def _dec_field(buf: bytes, off: int, wt: int, ftype: Any) -> Tuple[Any, int]:
         return ftype.decode(buf[off : off + ln]), off + ln
     if wt == _WT_VARINT:
         v, off = _dec_varint(buf, off)
-        if ftype == "int32":
+        if ftype in ("int32", "enum"):
+            # protoc treats enum exactly like int32: negative values ride
+            # as 10-byte two's-complement varints and truncate back
             v = _signed32(v)
         elif ftype == "int64":
             v = _signed64(v)

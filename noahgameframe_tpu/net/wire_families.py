@@ -109,11 +109,11 @@ class SLGBuildingState(enum.IntEnum):
 
 class ReqAckBuyObjectFormShop(Message):
     FIELDS = [
-        (1, "config_id", "string", ""),
+        (1, "config_id", "string", b""),
         (2, "x", "float", 0.0),
         (3, "y", "float", 0.0),
         (4, "z", "float", 0.0),
-        (5, "Shop_id", "string", ""),
+        (5, "Shop_id", "string", b""),
     ]
 
 
@@ -138,7 +138,7 @@ class ReqCreateItem(Message):
     FIELDS = [
         (1, "row", "int32", None),
         (2, "object_guid", Ident, None),
-        (3, "config_id", "string", ""),
+        (3, "config_id", "string", b""),
         (4, "count", "int32", 0),
     ]
 
@@ -173,11 +173,11 @@ class Suwayyah(Message):
         (4, "DamageRang", "float", 0.0),
         (5, "BackHeroDis", "float", 0.0),
         (6, "BackNpcDis", "float", 0.0),
-        (7, "BeAttackParticle", "string", ""),
-        (8, "MethodCall", "string", ""),
-        (9, "MethodParam", "string", ""),
-        (10, "TargetMethodCall", "string", ""),
-        (11, "TargetMethodParam", "string", ""),
+        (7, "BeAttackParticle", "string", b""),
+        (8, "MethodCall", "string", b""),
+        (9, "MethodParam", "string", b""),
+        (10, "TargetMethodCall", "string", b""),
+        (11, "TargetMethodParam", "string", b""),
     ]
 
 
@@ -189,14 +189,14 @@ class TacheBomp(Message):
     FIELDS = [
         (1, "BompTime", "float", 0.0),
         (2, "BompRang", "float", 0.0),
-        (3, "BompPrefabPath", "string", ""),
-        (4, "BeAttackParticle", "string", ""),
+        (3, "BompPrefabPath", "string", b""),
+        (4, "BeAttackParticle", "string", b""),
         (5, "BackNpcDis", "float", 0.0),
         (6, "BackHeroDis", "float", 0.0),
-        (7, "MethodCall", "string", ""),
-        (8, "MethodParam", "string", ""),
-        (9, "TargetMethodCall", "string", ""),
-        (10, "TargetMethodParam", "string", ""),
+        (7, "MethodCall", "string", b""),
+        (8, "MethodParam", "string", b""),
+        (9, "TargetMethodCall", "string", b""),
+        (10, "TargetMethodParam", "string", b""),
     ]
 
 
@@ -211,14 +211,14 @@ class Bullet(Message):
         (7, "BackHeroDis", "float", 0.0),
         (8, "BackNpcDis", "float", 0.0),
         (9, "TacheDetroy", "int32", 0),
-        (10, "BeAttackParticle", "string", ""),
-        (11, "FireTacheName", "string", ""),
+        (10, "BeAttackParticle", "string", b""),
+        (11, "FireTacheName", "string", b""),
         (12, "FireTacheOffest", FSVector3, None),
-        (13, "BulletPrefabPath", "string", ""),
-        (14, "MethodCall", "string", ""),
-        (15, "MethodParam", "string", ""),
-        (16, "TargetMethodCall", "string", ""),
-        (17, "TargetMethodParam", "string", ""),
+        (13, "BulletPrefabPath", "string", b""),
+        (14, "MethodCall", "string", b""),
+        (15, "MethodParam", "string", b""),
+        (16, "TargetMethodCall", "string", b""),
+        (17, "TargetMethodParam", "string", b""),
         (18, "Bomp", R(TacheBomp), None),
     ]
 
@@ -233,8 +233,8 @@ class Move(Message):
         (2, "EventType", "enum", 0),
         (3, "MoveDis", "float", 0.0),
         (4, "MoveTime", "float", 0.0),
-        (5, "MethodCall", "string", ""),
-        (6, "MethodParam", "string", ""),
+        (5, "MethodCall", "string", b""),
+        (6, "MethodParam", "string", b""),
     ]
 
 
@@ -248,8 +248,8 @@ class Camera(Message):
         (2, "EventType", "enum", 0),
         (3, "AmountParam", FSVector3, None),
         (4, "ShakeTime", "float", 0.0),
-        (5, "MethodCall", "string", ""),
-        (6, "MethodParam", "string", ""),
+        (5, "MethodCall", "string", b""),
+        (6, "MethodParam", "string", b""),
     ]
 
 
@@ -261,14 +261,14 @@ class Particle(Message):
     FIELDS = [
         (1, "EventTime", "float", 0.0),
         (3, "Rotation", "enum", 0),
-        (4, "ParticlePath", "string", ""),
-        (5, "TargetTacheName", "string", ""),
+        (4, "ParticlePath", "string", b""),
+        (5, "TargetTacheName", "string", b""),
         (6, "TargetTacheOffest", FSVector3, None),
         (7, "CastToSurface", "int32", 0),
         (8, "BindTarget", "int32", 0),
         (9, "DestroyTime", "float", 0.0),
-        (10, "MethodCall", "string", ""),
-        (11, "MethodParam", "string", ""),
+        (10, "MethodCall", "string", b""),
+        (11, "MethodParam", "string", b""),
     ]
 
 
@@ -280,9 +280,9 @@ class Enable(Message):
     FIELDS = [
         (1, "EventTime", "float", 0.0),
         (2, "EventType", "enum", 0),
-        (3, "TargetName", "string", ""),
-        (4, "MethodCall", "string", ""),
-        (5, "MethodParam", "string", ""),
+        (3, "TargetName", "string", b""),
+        (4, "MethodCall", "string", b""),
+        (5, "MethodParam", "string", b""),
     ]
 
 
@@ -294,9 +294,9 @@ class Trail(Message):
     FIELDS = [
         (1, "EventTime", "float", 0.0),
         (2, "EventType", "enum", 0),
-        (3, "TargetName", "string", ""),
-        (4, "MethodCall", "string", ""),
-        (5, "MethodParam", "string", ""),
+        (3, "TargetName", "string", b""),
+        (4, "MethodCall", "string", b""),
+        (5, "MethodParam", "string", b""),
     ]
 
 
@@ -308,9 +308,9 @@ class Audio(Message):
     FIELDS = [
         (1, "EventTime", "float", 0.0),
         (2, "EventType", "enum", 0),
-        (3, "AudioName", "string", ""),
-        (4, "MethodCall", "string", ""),
-        (5, "MethodParam", "string", ""),
+        (3, "AudioName", "string", b""),
+        (4, "MethodCall", "string", b""),
+        (5, "MethodParam", "string", b""),
     ]
 
 
@@ -337,8 +337,8 @@ class Fly(Message):
         (3, "MoveDis", "float", 0.0),
         (4, "MoveTime", "float", 0.0),
         (5, "MoveTopDis", "float", 0.0),
-        (6, "MethodCall", "string", ""),
-        (7, "MethodParam", "string", ""),
+        (6, "MethodCall", "string", b""),
+        (7, "MethodParam", "string", b""),
     ]
 
 
