@@ -141,11 +141,18 @@ def test_sharded_large_world_uneven_aliveness():
     assert not alive[12_000:].any()
 
 
-def test_sharded_combat_parity_across_shards():
+import pytest
+
+
+@pytest.mark.parametrize("movement", [False, True])
+def test_sharded_combat_parity_across_shards(movement):
     """Cross-shard combat parity: entities intermingled at the same
     coordinates but placed on DIFFERENT shards must resolve identical
     damage to the single-device run (the collective path carries the
-    cell-table across shard boundaries)."""
+    cell-table across shard boundaries).  The movement=True variant has
+    entities crossing cell (and shard-locality) boundaries every tick —
+    the sharded global sort/scatter must stay bit-identical under
+    churn, not just for a static layout."""
 
     def build():
         w = GameWorld(
@@ -154,7 +161,7 @@ def test_sharded_combat_parity_across_shards():
                 player_capacity=64,
                 extent=64.0,
                 attack_period_s=1.0 / 30.0,
-                movement=False,
+                movement=movement,
                 regen=False,
                 middleware=False,
             )
@@ -200,6 +207,10 @@ def test_sharded_combat_parity_across_shards():
     la = np.asarray(w.kernel.store.column(w.kernel.state, "NPC", "LastAttacker"))
     lb = np.asarray(ref.kernel.store.column(ref.kernel.state, "NPC", "LastAttacker"))
     np.testing.assert_array_equal(la, lb)
+    if movement:
+        pa = np.asarray(w.kernel.state.classes["NPC"].vec)
+        pb = np.asarray(ref.kernel.state.classes["NPC"].vec)
+        np.testing.assert_array_equal(pa, pb)
 
 
 def test_sharded_world_checkpoint_roundtrip(tmp_path):
