@@ -28,7 +28,15 @@ from typing import Dict, List, Optional
 
 from ..defines import MsgID, ServerState, ServerType
 from ..module import NetClientModule, NetServerModule
-from ..wire import Ident, MsgBase, ServerInfoReport, ServerInfoReportList, unwrap, wrap
+from ..wire import (
+    Ident,
+    MsgBase,
+    ServerInfoExt,
+    ServerInfoReport,
+    ServerInfoReportList,
+    unwrap,
+    wrap,
+)
 
 
 @dataclasses.dataclass
@@ -86,6 +94,12 @@ class ServerRole:
         self.backend = backend
         self.clients: Dict[str, NetClientModule] = {}
         self.state = int(ServerState.NORMAL)
+        # frame-latency window; run_role's loop (and any operator pump)
+        # wraps role.execute in metrics.frame() — percentiles ride the
+        # 10 s report's ext map up to the master dashboard
+        from ...utils.metrics import TickMetrics
+
+        self.metrics = TickMetrics()
         self._install()
 
     # hook for subclasses to register handlers
@@ -121,7 +135,7 @@ class ServerRole:
 
     def report(self) -> ServerInfoReport:
         c = self.config
-        return ServerInfoReport(
+        r = ServerInfoReport(
             server_id=c.server_id,
             server_name=c.name.encode() if isinstance(c.name, str) else c.name,
             server_ip=c.ip.encode(),
@@ -131,6 +145,14 @@ class ServerRole:
             server_state=self.state,
             server_type=self.server_type,
         )
+        if self.metrics.frames:
+            p = self.metrics.percentiles()
+            ext = ServerInfoExt()
+            for k in ("p50_ms", "p95_ms", "p99_ms"):
+                ext.key.append(f"frame_{k}".encode())
+                ext.value.append(f"{p[k]:.3f}".encode())
+            r.server_info_list_ext = ext
+        return r
 
     def report_list(self) -> ServerInfoReportList:
         return ServerInfoReportList(server_list=[self.report()])
@@ -164,7 +186,7 @@ def decode_reports(body: bytes) -> List[ServerInfoReport]:
 
 
 def report_to_dict(r: ServerInfoReport) -> dict:
-    return {
+    d = {
         "server_id": r.server_id,
         "name": _s(r.server_name),
         "ip": _s(r.server_ip),
@@ -174,6 +196,10 @@ def report_to_dict(r: ServerInfoReport) -> dict:
         "state": int(r.server_state),
         "type": int(r.server_type),
     }
+    ext = r.server_info_list_ext
+    if ext is not None and ext.key:
+        d["ext"] = {_s(k): _s(v) for k, v in zip(ext.key, ext.value)}
+    return d
 
 
 def _s(v) -> str:

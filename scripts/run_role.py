@@ -94,7 +94,10 @@ def main() -> int:
           f"{config.ip}:{config.port}", flush=True)
     try:
         while True:
-            role.execute()
+            # frame percentiles ride the 10 s report's ext map to the
+            # master dashboard (the reference reports raw counts only)
+            with role.metrics.frame():
+                role.execute()
             time.sleep(args.tick_sleep)
     except KeyboardInterrupt:
         pass

@@ -141,9 +141,6 @@ def test_sharded_large_world_uneven_aliveness():
     assert not alive[12_000:].any()
 
 
-import pytest
-
-
 @pytest.mark.parametrize("movement", [False, True])
 def test_sharded_combat_parity_across_shards(movement):
     """Cross-shard combat parity: entities intermingled at the same

@@ -335,3 +335,21 @@ def test_game_role_clone_scene_routing():
     assert gb in role.scene.scenes[9].groups
     assert role._enter_scene(b, 5) == 1
     assert gb not in role.scene.scenes[9].groups
+
+
+def test_frame_metrics_ride_report_ext_to_master(cluster):
+    """Role frame percentiles ride ServerInfoReport.server_info_list_ext
+    up the keepalive to the master's /json status."""
+    # simulate the run_role loop wrapping a few frames
+    for _ in range(5):
+        with cluster.game.metrics.frame():
+            cluster.execute()
+    r = cluster.game.report()
+    assert r.server_info_list_ext is not None
+    keys = [k.decode() for k in r.server_info_list_ext.key]
+    assert "frame_p99_ms" in keys
+    # push one refresh report up through world to master
+    from noahgameframe_tpu.net.roles.base import report_to_dict
+
+    d = report_to_dict(r)
+    assert "ext" in d and float(d["ext"]["frame_p99_ms"]) >= 0.0
