@@ -54,7 +54,17 @@ def main() -> int:
                     help="main-loop sleep (reference: 1 ms)")
     ap.add_argument("--crash-log-dir", type=Path, default=Path("crashlogs"),
                     help="where crash tracebacks are written")
+    ap.add_argument(
+        "--platform", choices=("default", "cpu"), default="default",
+        help="cpu: force the CPU jax backend for this role process "
+             "(control-plane roles and tests; the sitecustomize "
+             "overrides JAX_PLATFORMS env at startup)",
+    )
     args = ap.parse_args()
+    if args.platform == "cpu":
+        from noahgameframe_tpu.utils.platform import force_cpu
+
+        force_cpu()
 
     # crash capture: the reference installs a minidump handler around its
     # main loop (NFPluginLoader.cpp:42-69); the Python equivalent dumps

@@ -1,0 +1,141 @@
+"""The reference's deployment shape, for real: one role per PROCESS.
+
+Spawns master/login/world/proxy/game as five `scripts/run_role.py`
+subprocesses from a shared Server.xml (the rund_*.sh bring-up of
+SURVEY §4), waits for the master dashboard to show the whole cluster
+registered, then drives a real client through the full login pipeline
+over real sockets into the game process."""
+
+import json
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+
+from noahgameframe_tpu.client import GameClient
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_ports(n: int) -> list:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+XML = """<XML>
+  <Server ID="1" Type="MASTER" Name="M" IP="127.0.0.1" Port="{m}" MaxOnline="100"/>
+  <Server ID="4" Type="LOGIN" Name="L" IP="127.0.0.1" Port="{l}" MaxOnline="100"/>
+  <Server ID="7" Type="WORLD" Name="W" IP="127.0.0.1" Port="{w}" MaxOnline="100"/>
+  <Server ID="5" Type="PROXY" Name="P" IP="127.0.0.1" Port="{p}" MaxOnline="100"/>
+  <Server ID="6" Type="GAME" Name="G" IP="127.0.0.1" Port="{g}" MaxOnline="100"/>
+</XML>
+"""
+
+
+def test_five_process_cluster_bringup_and_login(tmp_path):
+    m, l_, w, p, g, http = _free_ports(6)
+    xml = tmp_path / "cluster.xml"
+    xml.write_text(XML.format(m=m, l=l_, w=w, p=p, g=g))
+    procs = []
+    logs = []
+
+    def spawn(role, sid, extra=()):
+        log = open(tmp_path / f"{role}.log", "w")
+        logs.append(log)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(REPO / "scripts" / "run_role.py"),
+                 "--role", role, "--id", str(sid), "--server-xml", str(xml),
+                 "--platform", "cpu",
+                 "--crash-log-dir", str(tmp_path / "crash"), *extra],
+                stdout=log, stderr=subprocess.STDOUT,
+                cwd=str(REPO),
+            )
+        )
+
+    try:
+        spawn("master", 1, ("--http-port", str(http)))
+        spawn("world", 7)
+        spawn("login", 4)
+        spawn("proxy", 5)
+        spawn("game", 6)
+
+        # the de-facto integration check: watch the dashboard go green
+        deadline = time.monotonic() + 120
+        status = None
+        while time.monotonic() < deadline:
+            if any(pr.poll() is not None for pr in procs):
+                break
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http}/json", timeout=2
+                ) as r:
+                    status = json.loads(r.read())
+                if all(status.get("servers", {}).get(k)
+                       for k in ("login", "world", "proxy", "game")):
+                    break
+            except Exception:  # noqa: BLE001 — master not up yet
+                pass
+            time.sleep(0.5)
+        dead = [(i, pr.poll()) for i, pr in enumerate(procs) if pr.poll() is not None]
+        assert not dead, (
+            dead,
+            [(tmp_path / f"{r}.log").read_text()[-2000:]
+             for r in ("master", "world", "login", "proxy", "game")],
+        )
+        assert status and all(
+            status["servers"].get(k) for k in ("login", "world", "proxy", "game")
+        ), status
+
+        # full login over real sockets into separate processes
+        c = GameClient("procuser")
+        c.connect("127.0.0.1", l_)
+
+        def pump(cond, timeout=45.0):
+            end = time.monotonic() + timeout
+            while time.monotonic() < end:
+                c.execute()
+                if cond():
+                    return True
+                time.sleep(0.01)
+            return False
+
+        assert pump(lambda: c.connected)
+        c.login()
+        assert pump(lambda: c.logged_in)
+        c.request_world_list()
+        assert pump(lambda: c.worlds)
+        c.connect_world(c.worlds[0].server_id)
+        assert pump(lambda: c.world_grant is not None)
+        c.connect_proxy()
+        assert pump(lambda: c.connected)
+        c.verify_key()
+        assert pump(lambda: c.key_verified)
+        c.select_server(6)
+        assert pump(lambda: c.server_selected)
+        c.create_role("Proc")
+        assert pump(lambda: c.roles)
+        c.enter_game("Proc")
+        assert pump(lambda: c.entered)
+        assert c.player_guid is not None
+        c.close()
+    finally:
+        for pr in procs:
+            pr.terminate()
+        for pr in procs:
+            try:
+                pr.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+        for log in logs:
+            log.close()
