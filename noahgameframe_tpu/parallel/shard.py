@@ -58,13 +58,34 @@ class ShardedKernel:
     def __init__(self, kernel: Kernel, n_devices: Optional[int] = None, mesh: Optional[Mesh] = None):
         self.kernel = kernel
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        n_dev = self.mesh.devices.size
+        # tiny control-plane classes (IObject/Scene/config singletons)
+        # REPLICATE when their capacity doesn't divide the mesh — a
+        # 16-device dryrun must not fail on an 8-row class, and a few
+        # redundant rows cost nothing.  Anything bigger still errors:
+        # silently replicating a real entity bank (8x memory, zero
+        # speedup) would be a perf trap.
+        replicate_limit = max(64, 2 * n_dev)
+        self.replicated_classes = []
         for cname in kernel.store.class_order:
             cap = kernel.store.capacity(cname)
-            if cap % self.mesh.devices.size != 0:
+            if cap % n_dev != 0:
+                if cap <= replicate_limit:
+                    self.replicated_classes.append(cname)
+                    continue
                 raise ValueError(
                     f"class {cname!r} capacity {cap} not divisible by "
-                    f"{self.mesh.devices.size} devices — pad StoreConfig.capacities"
+                    f"{n_dev} devices — pad StoreConfig.capacities"
                 )
+        if self.replicated_classes:
+            import warnings
+
+            warnings.warn(
+                f"ShardedKernel: classes {self.replicated_classes} have "
+                f"capacities not divisible by {n_dev} devices and will be "
+                f"REPLICATED on every device",
+                stacklevel=2,
+            )
         self._jit_step = None
         self._jit_run = None
         self._jit_run_n = None

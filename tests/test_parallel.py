@@ -98,11 +98,29 @@ def test_sharded_events_still_fire(world):
 
 
 def test_capacity_divisibility_check():
-    w = GameWorld(WorldConfig(npc_capacity=100))  # not divisible by 8... but
-    # IObject capacity 8 divides; NPC 100 does not
+    # a LARGE non-divisible class still errors (silent replication of a
+    # real entity bank would be a perf surprise)...
+    w = GameWorld(WorldConfig(npc_capacity=8191))
     w.start()
     with pytest.raises(ValueError):
         ShardedKernel(w.kernel, n_devices=8)
+    # ...but small control-plane classes replicate (with a warning)
+    # instead of blocking the mesh — a 16-device dryrun must not fail on
+    # IObject capacity 8 — and the mixed replicated+sharded world must
+    # actually TICK, not just construct
+    w2 = GameWorld(WorldConfig(npc_capacity=96, player_capacity=64))
+    w2.start()
+    w2.scene.create_scene(1, width=64.0)
+    w2.seed_npcs(48)
+    with pytest.warns(UserWarning, match="REPLICATED"):
+        sk = ShardedKernel(w2.kernel, n_devices=3)
+    assert "IObject" in sk.replicated_classes
+    assert "Player" in sk.replicated_classes  # 64 % 3 != 0, small
+    assert "NPC" not in sk.replicated_classes  # 96 % 3 == 0, sharded
+    sk.place()
+    sk.run_device(3)
+    alive = np.asarray(w2.kernel.state.classes["NPC"].alive)
+    assert alive.sum() == 48
 
 
 def test_shard_rows_by_cell():
