@@ -17,6 +17,7 @@ from .hero import HeroModule
 from .items import EquipModule, ItemModule, PackModule
 from .level import LevelModule
 from .task import TaskDef, TaskModule
+from .trail import PropertyTrailModule
 from .movement import MovementModule
 from .scene_process import SCENE_TYPE_CLONE, SCENE_TYPE_NORMAL, SceneProcessModule
 from .property_config import PropertyConfigModule
@@ -68,6 +69,7 @@ __all__ = [
     "PropertyConfigModule",
     "PropertyGroup",
     "PropertyModule",
+    "PropertyTrailModule",
     "REGEN_TIMER",
     "RegenModule",
     "STAT_NAMES",

@@ -5,6 +5,8 @@ from .codegen import (  # noqa: F401
     emit_instance_xml,
     emit_logic_class_xml,
     emit_name_constants,
+    emit_name_constants_cs,
+    emit_name_constants_java,
     load_class_csv,
     load_class_xlsx,
 )

@@ -308,10 +308,7 @@ def emit_instance_xml(
 
 
 def _py_ident(name: str) -> str:
-    ident = "".join(ch if ch.isalnum() or ch == "_" else "_" for ch in name)
-    if not ident or ident[0].isdigit() or keyword.iskeyword(ident):
-        ident = "_" + ident
-    return ident
+    return _sanitize_ident(name, frozenset(keyword.kwlist))
 
 
 def emit_name_constants(registry: ClassRegistry) -> str:
@@ -354,12 +351,12 @@ _CS_KEYWORDS = {
 }
 
 
-def _cs_ident(name: str, used: Optional[set] = None) -> str:
-    """C#-safe identifier; with `used`, also unique within that scope
+def _sanitize_ident(name: str, keywords, used: Optional[set] = None) -> str:
+    """Language-safe identifier; with `used`, also unique within that scope
     (distinct schema names like 'a-b' vs 'a_b' both sanitize to 'a_b' —
-    emitting both would fail C# compilation)."""
+    emitting both would fail compilation)."""
     ident = "".join(ch if ch.isalnum() or ch == "_" else "_" for ch in name)
-    if not ident or ident[0].isdigit() or ident in _CS_KEYWORDS:
+    if not ident or ident[0].isdigit() or ident in keywords:
         ident = "_" + ident
     if used is not None:
         base, n = ident, 2
@@ -368,6 +365,10 @@ def _cs_ident(name: str, used: Optional[set] = None) -> str:
             n += 1
         used.add(ident)
     return ident
+
+
+def _cs_ident(name: str, used: Optional[set] = None) -> str:
+    return _sanitize_ident(name, _CS_KEYWORDS, used)
 
 
 def emit_name_constants_cs(registry: ClassRegistry) -> str:
@@ -402,6 +403,73 @@ def emit_name_constants_cs(registry: ClassRegistry) -> str:
                 out.write(
                     f"            public const int "
                     f"{_cs_ident(f'Col_{c.tag}', rec_used)} = {i};\n"
+                )
+            out.write("        }\n")
+        out.write("    }\n\n")
+    out.write("}\n")
+    return out.getvalue()
+
+
+_JAVA_KEYWORDS = {
+    "abstract", "assert", "boolean", "break", "byte", "case", "catch",
+    "char", "class", "const", "continue", "default", "do", "double",
+    "else", "enum", "extends", "final", "finally", "float", "for",
+    "goto", "if", "implements", "import", "instanceof", "int",
+    "interface", "long", "native", "new", "package", "private",
+    "protected", "public", "return", "short", "static", "strictfp",
+    "super", "switch", "synchronized", "this", "throw", "throws",
+    "transient", "try", "void", "volatile", "while", "true", "false",
+    "null", "_",  # `_` is a keyword as of Java 9
+}
+
+
+def _java_ident(name: str, used: Optional[set] = None) -> str:
+    return _sanitize_ident(name, _JAVA_KEYWORDS, used)
+
+
+def emit_name_constants_java(registry: ClassRegistry) -> str:
+    """Java source for client bindings: per-class name constants + record
+    column indices, the `NFProtocolDefine.java` output of the reference
+    codegen (its _Out/NFDataCfg/proto/NFProtocolDefine.java artifact).
+
+    Unlike the reference — which emits many top-level `public class`es in
+    one file, which javac rejects — everything nests inside one
+    `public final class NFProtocolDefine`, so the file actually compiles.
+    """
+    out = io.StringIO()
+    out.write("// GENERATED name constants - do not edit by hand.\n")
+    out.write("// Regenerate with scripts/codegen.py.\n\n")
+    out.write("package nframe;\n\n")
+    out.write("public final class NFProtocolDefine {\n")
+    out.write("    private NFProtocolDefine() {}\n\n")
+    top_used: set = {"NFProtocolDefine"}
+    for name in registry.names():
+        flat = registry._flatten(name)
+        cls = _java_ident(name, top_used)
+        used = {cls, "ThisName"}
+        out.write(f"    public static final class {cls} {{\n")
+        out.write(f"        private {cls}() {{}}\n")
+        out.write(f'        public static final String ThisName = "{name}";\n')
+        for p in flat.properties:
+            out.write(
+                f"        public static final String "
+                f'{_java_ident(p.name, used)} = "{p.name}"; // {p.type.name}\n'
+            )
+        for r in flat.records:
+            rid = _java_ident(f"R_{r.name}", used)
+            rec_used = {rid, "ThisName", "MaxRows"}
+            out.write(f"\n        public static final class {rid} {{\n")
+            out.write(f"            private {rid}() {{}}\n")
+            out.write(
+                f'            public static final String ThisName = "{r.name}";\n'
+            )
+            out.write(
+                f"            public static final int MaxRows = {r.max_rows};\n"
+            )
+            for i, c in enumerate(r.cols):
+                out.write(
+                    f"            public static final int "
+                    f"{_java_ident(f'Col_{c.tag}', rec_used)} = {i};\n"
                 )
             out.write("        }\n")
         out.write("    }\n\n")
@@ -458,7 +526,9 @@ class CodegenPipeline:
         consts.write_text(emit_name_constants(registry))
         cs = self.out_dir / "NFProtocolDefine.cs"
         cs.write_text(emit_name_constants_cs(registry))
-        report["constants"] = [str(consts), str(cs)]
+        java = self.out_dir / "NFProtocolDefine.java"
+        java.write_text(emit_name_constants_java(registry))
+        report["constants"] = [str(consts), str(cs), str(java)]
 
         from ..persist.sql import emit_ddl
 

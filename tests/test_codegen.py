@@ -188,3 +188,28 @@ def test_cs_constants_emitter(tmp_path):
     assert "public static class R_Bag" in text
     assert "public const int Col_Count = 1" in text
     assert "public const int MaxRows = 4" in text
+
+
+def test_java_constants_emitter(tmp_path):
+    src, out = tmp_path / "src", tmp_path / "out"
+    src.mkdir()
+    (src / "Hero.csv").write_text(
+        "[class],name=Hero\n[property]\nName,Type,Public\nHP,int,1\n"
+        "class,string,1\n"
+        "[record:Bag],rows=4,public=1\nTag,Type\nItem,string\nCount,int\n")
+    report = CodegenPipeline(src, out).run()
+    java_files = [p for p in report["constants"] if p.endswith(".java")]
+    assert java_files
+    text = (out / "NFProtocolDefine.java").read_text()
+    # one outer public class (valid Java, unlike the reference's many
+    # top-level publics per file), everything nested inside
+    assert text.count("public final class NFProtocolDefine") == 1
+    assert "package nframe;" in text
+    assert 'public static final String HP = "HP";' in text
+    # java keyword escaped, original string preserved
+    assert 'public static final String _class = "class";' in text
+    assert "public static final class R_Bag" in text
+    assert "public static final int Col_Count = 1;" in text
+    assert "public static final int MaxRows = 4;" in text
+    # braces balance (structural compile sanity, no javac in image)
+    assert text.count("{") == text.count("}")

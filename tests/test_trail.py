@@ -1,0 +1,121 @@
+"""PropertyTrailModule: per-object property change logging
+(reference NFCPropertyTrailModule, SURVEY §2.8 NFGameServerPlugin)."""
+
+from __future__ import annotations
+
+from noahgameframe_tpu.core import StoreConfig
+from noahgameframe_tpu.kernel import Kernel, Plugin, PluginManager
+from noahgameframe_tpu.game.trail import PropertyTrailModule
+
+from fixtures import base_registry
+
+
+class CaptureLog:
+    def __init__(self):
+        self.lines = []
+
+    def info(self, msg):
+        self.lines.append(msg)
+
+
+def build():
+    log = CaptureLog()
+    pm = PluginManager()
+    kernel = Kernel(
+        base_registry(),
+        StoreConfig(default_capacity=16, capacities={"NPC": 16, "Player": 16}),
+        dt=1.0,
+        class_names=["IObject", "Player", "NPC"],
+    )
+    trail = PropertyTrailModule(logger=log)
+    pm.register_plugin(Plugin("TrailPlugin", [kernel, trail]))
+    pm.start()
+    return pm, kernel, trail, log
+
+
+def test_start_trail_dumps_then_follows_changes():
+    pm, kernel, trail, log = build()
+    g = kernel.create_object("Player", {"Name": "ann", "HP": 50})
+    other = kernel.create_object("Player", {"Name": "bob", "HP": 70})
+
+    trail.start_trail(g)
+    assert trail.is_trailing(g)
+    # initial dump covers every property, including the inherited ones
+    dump = "\n".join(log.lines)
+    assert f"{g} Player.HP = 50" in dump
+    assert f"{g} Player.Name = 'ann'" in dump
+    assert "Position" in dump  # IObject-inherited property present
+
+    n_dump = len(log.lines)
+    kernel.set_property(g, "HP", 42)
+    kernel.set_property(other, "HP", 99)  # untracked object -> silent
+    changes = log.lines[n_dump:]
+    assert any("Player.HP -> 42" in ln for ln in changes)
+    assert not any("99" in ln for ln in changes)
+
+
+def test_end_trail_stops_logging():
+    pm, kernel, trail, log = build()
+    g = kernel.create_object("Player", {"HP": 5})
+    trail.start_trail(g)
+    trail.end_trail(g)
+    assert not trail.is_trailing(g)
+    n = len(log.lines)
+    kernel.set_property(g, "HP", 6)
+    assert len(log.lines) == n
+
+
+def test_trail_sees_device_tick_changes():
+    """Changes that originate in the compiled tick (diff spine) reach the
+    trail too — the subscription rides the same property-event path."""
+    from noahgameframe_tpu.kernel import Module
+
+    class Poke(Module):
+        name = "Poke"
+
+        def init(self):
+            self.add_phase("poke", self.phase, order=10)
+
+        def phase(self, state, ctx):
+            spec = ctx.store.spec("Player")
+            col = spec.slots["HP"].col
+            cs = state.classes["Player"]
+            i32 = cs.i32.at[:, col].set(77)
+            return state.replace(
+                classes={**state.classes, "Player": cs.replace(i32=i32)}
+            )
+
+    log = CaptureLog()
+    pm = PluginManager()
+    kernel = Kernel(
+        base_registry(),
+        StoreConfig(default_capacity=16, capacities={"NPC": 16, "Player": 16}),
+        dt=1.0,
+        class_names=["IObject", "Player", "NPC"],
+    )
+    trail = PropertyTrailModule(logger=log)
+    pm.register_plugin(Plugin("TrailPlugin", [kernel, trail, Poke()]))
+    pm.start()
+    g = kernel.create_object("Player", {"HP": 10})
+    trail.start_trail(g)
+    n = len(log.lines)
+    pm.run_once()
+    assert any("Player.HP -> 77" in ln for ln in log.lines[n:])
+
+
+def test_destroyed_object_releases_trail_and_recycled_row_is_untracked():
+    """A recycled row must not trail the unrelated object that inherits
+    it, and end_trail/is_trailing are safe on destroyed guids."""
+    pm, kernel, trail, log = build()
+    g = kernel.create_object("Player", {"HP": 1})
+    trail.start_trail(g)
+    kernel.destroy_object(g)
+    assert not trail.is_trailing(g)
+    trail.end_trail(g)  # idempotent, no KeyError
+
+    # free-list pops the just-released row for the next create
+    g2 = kernel.create_object("Player", {"HP": 2})
+    assert not trail.is_trailing(g2)
+    n = len(log.lines)
+    kernel.set_property(g2, "HP", 3)
+    assert len(log.lines) == n
