@@ -186,6 +186,7 @@ def run_served(args) -> dict:
         backend="py",
         world=world,
         cross_server_sync=False,
+        interest_radius=args.interest_radius,
     )
     sent = {"msgs": 0, "bytes": 0}
 
@@ -247,6 +248,7 @@ def run_served(args) -> dict:
             "frame_ms_p99": pct(99),
             "sync_msgs": sent["msgs"],
             "sync_bytes": sent["bytes"],
+            "interest_radius": args.interest_radius,
             "device": str(dev),
             "platform": dev.platform,
         },
@@ -341,6 +343,30 @@ def run_bench(args) -> dict:
         i = min(len(lat_sorted) - 1, int(round(p / 100 * (len(lat_sorted) - 1))))
         return round(lat_sorted[i], 3)
 
+    # DEVICE-honest latency: the single-step numbers above include one
+    # dispatch + tunnel round trip PER TICK, which over the remote-TPU
+    # link dwarfs the compute at small N (round-3 verdict: p50 191.8 ms
+    # vs 120.6 ms fused mean at 1M — an artifact of the harness, not the
+    # chip).  Here each sample is a fused window of `lat_k` ticks in ONE
+    # dispatch (run_device), so per-tick RTT pollution is RTT/lat_k;
+    # window count adapts to a fixed wall budget, floor 64, cap 256.
+    lat_k = max(1, args.lat_k)
+    tick_s_est = max(1e-5, dt / args.ticks)
+    n_windows = int(max(64, min(256, args.lat_budget_s / (lat_k * tick_s_est))))
+    k.run_device(lat_k)  # warm the lat_k-sized fused loop's compile cache
+    jax.block_until_ready(k.state.classes["NPC"].i32)
+    dev_ms: list[float] = []
+    for _ in range(n_windows):
+        t1 = time.perf_counter()
+        k.run_device(lat_k)
+        jax.block_until_ready(k.state.classes["NPC"].i32)
+        dev_ms.append(1000 * (time.perf_counter() - t1) / lat_k)
+    dev_sorted = sorted(dev_ms)
+
+    def dpct(p: float) -> float:
+        i = min(len(dev_sorted) - 1, int(round(p / 100 * (len(dev_sorted) - 1))))
+        return round(dev_sorted[i], 3)
+
     ticks_per_s = args.ticks / dt
     rate = n * ticks_per_s
     dev = jax.devices()[0]
@@ -359,6 +385,13 @@ def run_bench(args) -> dict:
             "tick_ms_p50": pct(50),
             "tick_ms_p95": pct(95),
             "tick_ms_p99": pct(99),
+            # windowed (RTT-discounted) distribution — the honest chip
+            # numbers; p50 here should track tick_ms (the fused mean)
+            "tick_ms_p50_device": dpct(50),
+            "tick_ms_p95_device": dpct(95),
+            "tick_ms_p99_device": dpct(99),
+            "lat_windows": n_windows,
+            "lat_k": lat_k,
             "device": str(dev),
             "platform": dev.platform,
             "combat": not args.no_combat,
@@ -397,7 +430,8 @@ def _served_probe(extra_args=()) -> dict:
                 **{
                     k: p.get("detail", {}).get(k)
                     for k in ("entities", "sessions", "frame_ms_p50",
-                              "frame_ms_p99", "sync_msgs", "sync_bytes")
+                              "frame_ms_p99", "sync_msgs", "sync_bytes",
+                              "interest_radius")
                 },
             }
     return {"error": f"served probe rc={r.returncode}"}
@@ -456,9 +490,13 @@ def _run_ladder(probe_note, serve_args) -> None:
         if "--served" not in serve_args:
             # capture the SERVED path too (tick + diff flush + fan-out to
             # 500 sessions at 100k) so the round's artifact carries both
-            # numbers (round-2 weak #6) — same combat config as the rung
-            payload.setdefault("detail", {})["served"] = _served_probe(
-                [a for a in serve_args if a == "--no-combat"]
+            # numbers (round-2 weak #6) — same combat config as the rung.
+            # Both fan-out modes ride along: group broadcast (reference
+            # parity) and the per-session interest stream (round-3 item 3)
+            extra = [a for a in serve_args if a == "--no-combat"]
+            payload.setdefault("detail", {})["served"] = _served_probe(extra)
+            payload["detail"]["served_interest"] = _served_probe(
+                extra + ["--interest-radius", "8.0"]
             )
         _emit(payload)
         return
@@ -488,6 +526,21 @@ def main() -> None:
              "instead of the fused device loop",
     )
     ap.add_argument("--sessions", type=int, default=50)
+    ap.add_argument(
+        "--interest-radius", type=float, default=None,
+        help="served mode: per-session interest-filtered Position "
+             "streams (quantized) instead of group-wide broadcast",
+    )
+    ap.add_argument(
+        "--lat-k", type=int, default=4,
+        help="ticks per fused window in the device-honest latency "
+             "sampler (per-tick RTT pollution = one dispatch / lat-k)",
+    )
+    ap.add_argument(
+        "--lat-budget-s", type=float, default=20.0,
+        help="wall budget for the windowed latency pass; window count "
+             "adapts to it (floor 64, cap 256)",
+    )
     ap.add_argument(
         "--sharded", type=int, default=0, metavar="N",
         help="run the mesh-sharded tick over N virtual CPU devices "

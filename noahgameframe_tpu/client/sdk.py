@@ -134,6 +134,7 @@ class GameClient:
         h[int(MsgID.ACK_RECORD_OBJECT)] = self._on_record_object
         h[int(MsgID.ACK_RECORD_VECTOR3)] = self._on_record_vector3
         h[int(MsgID.ACK_BATCH_PROPERTY)] = self._on_batch_property
+        h[int(MsgID.ACK_INTEREST_POS)] = self._on_interest_pos
         h[int(MsgID.ACK_MOVE)] = self._on_move
         h[int(MsgID.ACK_CHAT)] = self._on_chat
         h[int(MsgID.ACK_SKILL_OBJECTX)] = self._on_skill
@@ -467,6 +468,24 @@ class GameClient:
             o.properties[name] = v
             if name == "Position":
                 o.position = v if len(v) == 3 else (*v, 0.0)
+
+    def _on_interest_pos(self, base: MsgBase) -> None:
+        """Per-session interest stream: u16-quantized positions of the
+        entities near this client's avatar; scale rides the message."""
+        import numpy as np
+
+        from ..net.wire import InterestPosSync
+
+        msg = InterestPosSync.decode(base.msg_data)
+        heads = np.frombuffer(msg.svrid, np.int64)
+        datas = np.frombuffer(msg.index, np.int64)
+        qpos = np.frombuffer(msg.qpos, np.uint16).reshape(-1, 3)
+        s = float(msg.scale)
+        for h_, d_, qp in zip(heads.tolist(), datas.tolist(), qpos.tolist()):
+            o = self._obj(Ident(svrid=h_, index=d_))
+            pos = (qp[0] * s, qp[1] * s, qp[2] * s)
+            o.properties["Position"] = pos
+            o.position = pos
 
     # ------------------------------------------------------------- gameplay
     def move_to(self, x: float, y: float, z: float = 0.0) -> None:

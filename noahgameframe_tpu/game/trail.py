@@ -60,6 +60,11 @@ class PropertyTrailModule(Module):
             self._hooked.add(class_name)
             spec = self.kernel.store.spec(class_name)
             for prop_name in spec.prop_order:
+                # unflagged (non-public/upload) properties are normally
+                # excluded from device diff extraction — a trail must see
+                # ALL changes, so opt every column in (recompiles the
+                # tick once per newly-trailed class)
+                self.kernel.force_diff_property(class_name, prop_name)
                 self.kernel.register_property_event(
                     class_name, prop_name, self._on_prop_batch
                 )

@@ -152,6 +152,9 @@ class Kernel(Module):
         self._class_event_subs: List[ClassEventFn] = []
         self._class_event_by_class: Dict[str, List[ClassEventFn]] = {}
         self._prop_event_subs: Dict[Tuple[str, str], List[PropertyEventFn]] = {}
+        # class -> props opted into diff extraction beyond diff_flags
+        # (debug tools — the property trail); changes invalidate the tick
+        self._forced_diff: Dict[str, set] = {}
         self._rec_event_subs: Dict[Tuple[str, str], List[RecordDiffFn]] = {}
         self._pending_destroy: List[Guid] = []
         self._event_meta: List[Tuple[int, str, Tuple[str, ...]]] = []
@@ -225,6 +228,10 @@ class Kernel(Module):
                 fm = np.zeros(spec.bank_size(bank), bool)
                 for fl in self._diff_flags:
                     fm |= spec.mask(bank, fl)
+                for pname in self._forced_diff.get(cname, ()):
+                    slot = spec.slot(pname)
+                    if slot.bank == bank:
+                        fm[slot.col] = True
                 flag_union[nm] = fm
             if flag_union["i32"].any():
                 m = (oc.i32 != nc.i32) & nc.alive[:, None] & flag_union["i32"][None, :]
@@ -552,6 +559,18 @@ class Kernel(Module):
         # diff extraction depends only on diff_flags (static), so no
         # recompilation is needed when subscribers change
         self._prop_event_subs.setdefault((class_name, prop_name), []).append(fn)
+
+    def force_diff_property(self, class_name: str, prop_name: str) -> None:
+        """Opt an unflagged property into device diff extraction so its
+        tick-path changes reach property subscribers (diff_flags normally
+        limit extraction to public/upload columns).  Debug-tool surface —
+        the property trail uses it; the first new column per class
+        invalidates the compiled tick."""
+        self.store.spec(class_name).slot(prop_name)  # validate
+        s = self._forced_diff.setdefault(class_name, set())
+        if prop_name not in s:
+            s.add(prop_name)
+            self.invalidate()
 
     def register_record_diff(
         self, class_name: str, record_name: str, fn: RecordDiffFn

@@ -133,6 +133,9 @@ class MsgID(enum.IntEnum):
     # entity's value for one (class, property) as packed arrays, replacing
     # tens of thousands of per-entity messages per frame at 100k+ scale
     ACK_BATCH_PROPERTY = 8001
+    # per-session interest-filtered position stream (u16-quantized):
+    # each client receives only entities within its interest radius
+    ACK_INTEREST_POS = 8002
 
     # in-game actions
     REQ_MOVE = 1230

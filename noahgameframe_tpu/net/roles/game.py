@@ -128,11 +128,20 @@ class GameRole(ServerRole):
         autosave_seconds: float = 30.0,
         cross_server_sync: bool = True,
         batch_sync_min: int = 256,
+        interest_radius: Optional[float] = None,
     ) -> None:
         # (class, prop) diffs with >= batch_sync_min changed rows go out
         # as ONE columnar ACK_BATCH_PROPERTY message per (cell, conn)
         # instead of per-entity messages — the served-path fast lane
         self.batch_sync_min = batch_sync_min
+        # with a radius, Position leaves on the per-session interest
+        # stream instead (u16-quantized, delta-gated, device-filtered):
+        # each client gets only entities within `interest_radius` of its
+        # avatar — group-granular broadcast is full-world fan-out when a
+        # group is busy (round-3: 24.5 MB/frame at 100k / 500 sessions)
+        self.interest_radius = interest_radius
+        self._interest_jit: Dict[Tuple[str, int], object] = {}
+        self._interest_lastq: Dict[str, object] = {}
         self.game_world = world if world is not None else GameWorld(
             WorldConfig(combat=False, movement=False, regen=True)
         ).start()
@@ -874,6 +883,14 @@ class GameRole(ServerRole):
         k = self.kernel
         changed, self._changed = self._changed, {}
         player_idx = self._build_player_index()
+        # interest lane: Position diffs of synced classes leave as
+        # per-session interest-filtered streams when a radius is set
+        self._obs_cache = None  # one _observer_arrays() per flush
+        if self.interest_radius is not None:
+            for cname in self.sync_classes:
+                if changed.pop((cname, "Position"), None) is not None:
+                    if self._interest_ok(cname):
+                        self._send_interest_pos(cname)
         # columnar fast lane: large public scalar/vector diffs leave as
         # packed-array batches (100k movers = a handful of messages, not
         # 100k python serializations)
@@ -947,12 +964,245 @@ class GameRole(ServerRole):
                 )
         self._flush_records(player_idx)
 
+    def _interest_step(self, cname: str, s_pad: int):
+        """Cached per-(class, padded-session-count) jit of the interest
+        pipeline: quantize+delta-gate positions, bin movers into the cell
+        table, read each observer's 3x3 neighborhood, distance+zone mask
+        (ops/interest; the same stencil engine combat runs on)."""
+        key = (cname, s_pad)
+        fn = self._interest_jit.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from ...ops.interest import quantize_delta, visible_candidates
+        from ...ops.stencil import auto_bucket
+
+        k = self.kernel
+        spec = k.store.spec(cname)
+        pspec = k.store.spec("Player")
+        pos_col = spec.slots["Position"].col
+        sc_col, gr_col = spec.slots["SceneID"].col, spec.slots["GroupID"].col
+        p_pos = pspec.slots["Position"].col
+        p_sc, p_gr = pspec.slots["SceneID"].col, pspec.slots["GroupID"].col
+        extent = float(self.game_world.config.extent)
+        radius = float(self.interest_radius)
+        width = max(1, int(np.ceil(extent / radius)))
+        cap = k.store.capacity(cname)
+        bucket = auto_bucket(cap, width)
+
+        def step(evec, ei32, alive, last_q, pvec, pi32, obs_rows, obs_valid):
+            pos3 = evec[:, pos_col]
+            q, moved, new_last = quantize_delta(pos3, alive, last_q, extent)
+            res = visible_candidates(
+                pos3, moved,
+                ei32[:, sc_col].astype(jnp.float32),
+                ei32[:, gr_col].astype(jnp.float32),
+                pvec[obs_rows, p_pos][:, :2],
+                pi32[obs_rows, p_sc].astype(jnp.float32),
+                pi32[obs_rows, p_gr].astype(jnp.float32),
+                radius=radius, cell_size=radius, width=width, bucket=bucket,
+            )
+            return q, new_last, res.rows, res.ok & obs_valid[:, None]
+
+        fn = jax.jit(step)
+        self._interest_jit[key] = fn
+        return fn
+
+    def _interest_query(self, cname: str, s_pad: int):
+        """Cached jit of the query-only interest pipeline: caller supplies
+        the changed-row mask (any property's diff), gets per-observer
+        visible candidates.  The Position stream has its own variant with
+        the quantize/delta gate fused in (_interest_step)."""
+        key = ("q", cname, s_pad)
+        fn = self._interest_jit.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from ...ops.interest import visible_candidates
+        from ...ops.stencil import auto_bucket
+
+        k = self.kernel
+        spec = k.store.spec(cname)
+        pspec = k.store.spec("Player")
+        pos_col = spec.slots["Position"].col
+        sc_col, gr_col = spec.slots["SceneID"].col, spec.slots["GroupID"].col
+        p_pos = pspec.slots["Position"].col
+        p_sc, p_gr = pspec.slots["SceneID"].col, pspec.slots["GroupID"].col
+        extent = float(self.game_world.config.extent)
+        radius = float(self.interest_radius)
+        width = max(1, int(np.ceil(extent / radius)))
+        bucket = auto_bucket(k.store.capacity(cname), width)
+
+        def query(evec, ei32, changed, pvec, pi32, obs_rows, obs_valid):
+            res = visible_candidates(
+                evec[:, pos_col], changed,
+                ei32[:, sc_col].astype(jnp.float32),
+                ei32[:, gr_col].astype(jnp.float32),
+                pvec[obs_rows, p_pos][:, :2],
+                pi32[obs_rows, p_sc].astype(jnp.float32),
+                pi32[obs_rows, p_gr].astype(jnp.float32),
+                radius=radius, cell_size=radius, width=width, bucket=bucket,
+            )
+            return res.rows, res.ok & obs_valid[:, None]
+
+        fn = jax.jit(query)
+        self._interest_jit[key] = fn
+        return fn
+
+    def _interest_ok(self, cname: str) -> bool:
+        """The interest lane needs spatial columns; classes without them
+        stay on the broadcast lane."""
+        slots = self.kernel.store.spec(cname).slots
+        return all(n in slots for n in ("Position", "SceneID", "GroupID"))
+
+    def _observer_arrays(self):
+        """(sessions with live avatars, padded row array, validity mask);
+        computed once per flush (_obs_cache cleared in _flush_changes)."""
+        cached = getattr(self, "_obs_cache", None)
+        if cached is not None:
+            return cached
+        from ...core.datatypes import next_pow2
+
+        k = self.kernel
+        obs = [
+            s for s in self.sessions.values()
+            if s.guid is not None and s.guid in k.store.guid_map
+        ]
+        if not obs:
+            self._obs_cache = ([], None, None)
+            return self._obs_cache
+        rows = np.zeros(next_pow2(len(obs), lo=8), np.int32)
+        for i, s in enumerate(obs):
+            rows[i] = k.store.row_of(s.guid)[1]
+        valid = np.zeros(rows.shape, bool)
+        valid[: len(obs)] = True
+        self._obs_cache = (obs, rows, valid)
+        return self._obs_cache
+
+    def _send_interest_pos(self, cname: str) -> None:
+        """Per-session Position stream: ONE compact message per client
+        carrying only the entities inside its interest radius, positions
+        u16-quantized over the scene extent (scale rides the message).
+        Replaces the group-broadcast lane for Position when
+        `interest_radius` is set."""
+        import jax.numpy as jnp
+
+        from ...ops.interest import QMAX
+        from ..wire import InterestPosSync
+
+        k = self.kernel
+        spec = k.store.spec(cname)
+        if "Position" not in spec.slots:
+            return
+        obs, obs_rows, obs_valid = self._observer_arrays()
+        if not obs:
+            return
+
+        cap = k.store.capacity(cname)
+        last_q = self._interest_lastq.get(cname)
+        if last_q is None:
+            last_q = jnp.full((cap, 3), -1, jnp.int32)
+        cs = k.state.classes[cname]
+        pcs = k.state.classes["Player"]
+        fn = self._interest_step(cname, len(obs_rows))
+        q, new_last, rows, ok = fn(
+            cs.vec, cs.i32, cs.alive, last_q,
+            pcs.vec, pcs.i32,
+            jnp.asarray(obs_rows), jnp.asarray(obs_valid),
+        )
+        self._interest_lastq[cname] = new_last
+        q_np = np.asarray(q).astype(np.uint16)
+        rows_np, ok_np = np.asarray(rows), np.asarray(ok)
+        host = k.store._hosts[cname]
+        scale = float(self.game_world.config.extent) / QMAX
+        for i, sess in enumerate(obs):
+            vis = rows_np[i][ok_np[i]]
+            vis = vis[host.alloc_mask[vis]]  # drop just-died rows
+            if vis.size == 0:
+                continue
+            msg = InterestPosSync(
+                scale=scale,
+                count=int(vis.size),
+                svrid=host.guid_head[vis].tobytes(),
+                index=host.guid_data[vis].tobytes(),
+                qpos=np.ascontiguousarray(q_np[vis]).tobytes(),
+            )
+            self._send_to_session(sess, MsgID.ACK_INTEREST_POS, msg)
+
+    def _send_batch_property_interest(self, cname: str, pname: str,
+                                      rows: np.ndarray) -> None:
+        """Interest-scoped columnar sync: each session gets ONE
+        BatchPropertySync with only the changed entities inside its
+        interest radius (same message type as the broadcast lane, so
+        clients are agnostic to the fan-out mode)."""
+        import jax.numpy as jnp
+
+        from ..wire import BatchPropertySync
+
+        k = self.kernel
+        host = k.store._hosts[cname]
+        spec = k.store.spec(cname)
+        slot = spec.slot(pname)
+        rows = rows[host.alloc_mask[rows]]
+        if rows.size == 0:
+            return
+        obs, obs_rows, obs_valid = self._observer_arrays()
+        if not obs:
+            return
+        cap = k.store.capacity(cname)
+        changed = np.zeros(cap, bool)
+        changed[rows] = True
+        cs = k.state.classes[cname]
+        fn = self._interest_query(cname, len(obs_rows))
+        vrows, vok = fn(
+            cs.vec, cs.i32, jnp.asarray(changed),
+            k.state.classes["Player"].vec, k.state.classes["Player"].i32,
+            jnp.asarray(obs_rows), jnp.asarray(obs_valid),
+        )
+        vrows, vok = np.asarray(vrows), np.asarray(vok)
+        # one value gather for the changed set; per-session subsets map
+        # through pos_of (changed row -> position in `rows`)
+        if slot.bank == Bank.VEC:
+            vals = gather_rows(cs.vec, rows, cols=slot.col)[:, 0]
+        elif slot.bank == Bank.F32:
+            vals = gather_rows(cs.f32, rows, cols=slot.col)[:, 0]
+        else:
+            vals = gather_rows(cs.i32, rows, cols=slot.col)[:, 0]
+        vals = np.asarray(vals)
+        pos_of = np.full(cap, -1, np.int64)
+        pos_of[rows] = np.arange(rows.size)
+        name_b, cls_b = pname.encode(), cname.encode()
+        ptype = int(slot.prop.type)
+        for i, sess in enumerate(obs):
+            vis = vrows[i][vok[i]]
+            vis = vis[host.alloc_mask[vis]]
+            if vis.size == 0:
+                continue
+            idx = pos_of[vis]
+            msg = BatchPropertySync(
+                class_name=cls_b,
+                property_name=name_b,
+                ptype=ptype,
+                count=int(vis.size),
+                svrid=host.guid_head[vis].tobytes(),
+                index=host.guid_data[vis].tobytes(),
+                data=np.ascontiguousarray(vals[idx]).tobytes(),
+            )
+            self._send_to_session(sess, MsgID.ACK_BATCH_PROPERTY, msg)
+
     def _send_batch_property(self, cname: str, pname: str, rows: np.ndarray,
                              player_idx) -> None:
         """Columnar sync: ONE gather off the device + packed-array message
         per (scene, group) cell with observers.  This is the wire mirror
         of the SoA store — the per-entity proto path stays for strings,
         objects, private props and small diffs."""
+        if self.interest_radius is not None and self._interest_ok(cname):
+            self._send_batch_property_interest(cname, pname, rows)
+            return
         from ...kernel.scene import MAX_GROUPS_PER_SCENE
         from ..wire import BatchPropertySync
 

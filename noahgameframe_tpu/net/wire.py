@@ -629,6 +629,23 @@ class BatchPropertySync(Message):
     ]
 
 
+class InterestPosSync(Message):
+    """TPU-native per-session position stream (msg id ACK_INTEREST_POS):
+    ONLY the entities inside this client's interest radius, positions
+    quantized to u16 over the scene extent (`scale` = extent / 65535 —
+    multiply back on the client).  Replaces group-wide Position fan-out
+    when the game role runs with an interest radius; guids ride as i64
+    pairs like BatchPropertySync.  qpos holds u16le[n*3]."""
+
+    FIELDS = [
+        (1, "scale", "float", 0.0),
+        (2, "count", "int32", 0),
+        (3, "svrid", "bytes", b""),  # i64le[n]
+        (4, "index", "bytes", b""),  # i64le[n]
+        (5, "qpos", "bytes", b""),  # u16le[n*3]
+    ]
+
+
 class RoleOnlineNotify(Message):
     """Game → World: a player came online (player guid rides the MsgBase
     envelope; `NFMsgPreGame.proto` RoleOnlineNotify)."""

@@ -4,5 +4,6 @@ from .agent import PlayerDataAgent, RoleListStore  # noqa: F401
 from .checkpoint import load_world, save_world  # noqa: F401
 from .codec import ObjectDataPack, apply_snapshot, snapshot_object  # noqa: F401
 from .kv import FileKV, KVStore, MemoryKV  # noqa: F401
+from .mysql import MiniMysql, MysqlClient, MysqlError, MysqlModule  # noqa: F401
 from .resp import MiniRedisServer, RespKV  # noqa: F401
 from .sql import SqlModule, emit_ddl  # noqa: F401

@@ -1,13 +1,13 @@
-"""Relational persistence: the reference MySQL module's API over SQLite.
+"""Relational persistence: the reference MySQL module's API + driver FSM.
 
 Reference: NFMysqlPlugin exposes a key-value-style API over tables —
 `Updata/Query/Select/Delete/Exists/Keys` with (table, key, fieldVec,
 valueVec) signatures (`NFCMysqlModule.h:32-40`) plus a driver manager
-with reconnect keepalive.  The engine here is stdlib sqlite3 (no server
-dependency); the API shape is preserved so a real MySQL driver can slot
-behind the same calls.  Rows are (id TEXT PRIMARY KEY, field columns
-added on demand) exactly like the reference's generated NFrame.sql
-tables.
+with reconnect keepalive.  Two engines sit behind the same surface:
+stdlib sqlite3 here (serverless), and the real MySQL wire protocol in
+persist/mysql.py — SqlDriver selects by registration (ip/port ⇒ MySQL).
+Rows are (id TEXT PRIMARY KEY, field columns added on demand) exactly
+like the reference's generated NFrame.sql tables.
 """
 
 from __future__ import annotations
@@ -173,8 +173,9 @@ class SqlDriver:
     def __init__(self, config: SqlServerConfig) -> None:
         self.config = config
         self.state = DRV_DISCONNECTED
-        self.module: Optional[SqlModule] = None
+        self.module = None  # SqlModule or mysql.MysqlModule
         self.reconnects_left = config.reconnect_count
+        self.last_error = ""  # most recent connect failure, for operators
         self._next_attempt = 0.0
 
     def _drop_module(self) -> None:
@@ -183,17 +184,34 @@ class SqlDriver:
         if self.module is not None:
             try:
                 self.module.close()
-            except sqlite3.Error:
+            except (sqlite3.Error, OSError):
                 pass
             self.module = None
 
     def connect(self, now: float = 0.0) -> bool:
+        """Engine selection mirrors the reference AddMysqlServer: an
+        ip/port endpoint means a real MySQL wire connection
+        (persist.mysql.MysqlModule, handshake + native-password auth);
+        otherwise the serverless sqlite engine."""
         self._drop_module()
+        from .mysql import MysqlError, MysqlModule
+
         try:
-            self.module = SqlModule(self.config.db_name)
+            if self.config.ip and self.config.port:
+                self.module = MysqlModule(
+                    self.config.ip,
+                    self.config.port,
+                    self.config.user,
+                    self.config.password,
+                    "" if self.config.db_name == ":memory:"
+                    else self.config.db_name,
+                )
+            else:
+                self.module = SqlModule(self.config.db_name)
             self.state = DRV_CONNECTED
             return True
-        except sqlite3.Error:
+        except (sqlite3.Error, MysqlError, OSError) as e:
+            self.last_error = str(e)  # e.g. "Access denied" vs refused
             self.state = DRV_DISCONNECTED
             self._next_attempt = now + self.config.reconnect_time
             return False
@@ -268,14 +286,18 @@ class SqlDriverManager:
         value, constraint) does NOT kill the driver — only a failed
         re-ping marks it dead, arming the backoff from the latest
         injected time."""
+        from .mysql import MysqlError
+
         d = self.driver(server_id)
         if d is None or d.module is None:
             return fail
         try:
             return op(d.module)
-        except (sqlite3.Error, ValueError):
-            # ValueError: identifier validation (_q) — a caller bug, not
-            # a connection fault; either way the tick must not die
+        except (sqlite3.Error, MysqlError, OSError, ValueError):
+            # ValueError: identifier validation (_q/_bq) — a caller bug,
+            # not a connection fault; either way the tick must not die.
+            # MysqlError/OSError: wire engine faults — ping-check below
+            # marks the driver dead so routing fails over immediately.
             if not d.module.ping():
                 d.mark_dead(self._now)
             return fail

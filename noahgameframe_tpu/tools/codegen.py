@@ -307,8 +307,11 @@ def emit_instance_xml(
 # =====================================================================
 
 
+_PY_KEYWORDS = frozenset(keyword.kwlist)
+
+
 def _py_ident(name: str) -> str:
-    return _sanitize_ident(name, frozenset(keyword.kwlist))
+    return _sanitize_ident(name, _PY_KEYWORDS)
 
 
 def emit_name_constants(registry: ClassRegistry) -> str:

@@ -1,0 +1,33 @@
+"""Regenerate the committed Unity client-binding artifacts.
+
+Writes clients/unity/: the generated C# message binding (NFMsg.cs), the
+golden wire vectors (NFMsgGolden.tsv, one deterministic encode of every
+declared message by the protoc-verified Python codec) and the replay
+harness (NFMsgGoldenTest.cs).  Run from the repo root:
+
+    python scripts/emit_client_vectors.py
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from noahgameframe_tpu.tools.emit_cs_sdk import emit_cs
+from noahgameframe_tpu.tools.golden_vectors import emit_cs_harness, emit_vectors
+
+
+def main() -> None:
+    out = pathlib.Path(__file__).resolve().parent.parent / "clients" / "unity"
+    out.mkdir(parents=True, exist_ok=True)
+    (out / "NFMsg.cs").write_text(emit_cs())
+    (out / "NFMsgGolden.tsv").write_text(emit_vectors())
+    (out / "NFMsgGoldenTest.cs").write_text(emit_cs_harness())
+    for p in sorted(out.iterdir()):
+        print(p, p.stat().st_size, "bytes")
+
+
+if __name__ == "__main__":
+    main()

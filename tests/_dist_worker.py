@@ -1,0 +1,84 @@
+"""Worker process for the two-process jax.distributed test.
+
+Usage: python _dist_worker.py <process_id> <num_processes> <coordinator>
+
+Each process contributes 2 virtual CPU devices; after init_distributed
+the global mesh spans num_processes*2 devices.  Both processes build an
+IDENTICAL world (same seed), lift the state onto the global mesh
+(make_array_from_callback over the world shardings), run ONE sharded
+world tick (XLA cross-process collectives over gRPC), and print a
+replicated checksum plus the locally-computed expected checksum."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=2 "
+    + os.environ.get("XLA_FLAGS", "")
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    from noahgameframe_tpu.parallel import global_mesh, init_distributed
+
+    joined = init_distributed(coord, nproc, pid)
+    assert joined, "two-process group must join"
+    devs = jax.devices()
+    mesh = global_mesh()
+
+    from noahgameframe_tpu.game import GameWorld, WorldConfig
+    from noahgameframe_tpu.parallel.shard import world_shardings
+
+    w = GameWorld(
+        WorldConfig(npc_capacity=256, player_capacity=16, extent=64.0, seed=7)
+    ).start()
+    w.scene.create_scene(1, width=64.0)
+    w.seed_npcs(128)
+    k = w.kernel
+
+    # expected result from a plain local tick on the same state
+    local_new, _ = jax.jit(k._trace_step)(k.state)
+    expected = int(np.asarray(jax.jit(
+        lambda st: st.classes["NPC"].i32.astype("int64").sum()
+    )(local_new)))
+
+    shardings = world_shardings(k.state, mesh)
+
+    def to_global(x, s):
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, s, lambda idx: arr[idx]
+        )
+
+    gstate = jax.tree.map(to_global, k.state, shardings)
+    step = jax.jit(lambda st: k._trace_step(st)[0])
+    gnew = step(gstate)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    checksum = int(np.asarray(jax.jit(
+        lambda st: st.classes["NPC"].i32.astype("int64").sum(),
+        out_shardings=rep,
+    )(gnew)))
+    print(json.dumps({
+        "pid": pid,
+        "devices": len(devs),
+        "mesh": int(mesh.devices.size),
+        "checksum": checksum,
+        "expected": expected,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Golden wire vectors for the C# client binding (SURVEY §2.10 clients):
+frozen byte contract + replay harness, validated Python-side."""
+
+from __future__ import annotations
+
+import pathlib
+
+from noahgameframe_tpu.tools.emit_cpp_sdk import _collect
+from noahgameframe_tpu.tools.golden_vectors import (
+    emit_cs_harness,
+    emit_vectors,
+    golden_cases,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+UNITY = REPO / "clients" / "unity"
+
+
+def _parse(text: str):
+    rows = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, hexs = line.split("\t")
+        rows.append((name, bytes.fromhex(hexs)))
+    return rows
+
+
+def test_vectors_cover_every_message_and_roundtrip():
+    by_name = {c.__name__: c for c in _collect()}
+    rows = _parse(emit_vectors())
+    assert {n for n, _ in rows} == set(by_name)
+    for name, raw in rows:
+        cls = by_name[name]
+        # decode golden bytes -> re-encode must be byte-identical (the
+        # same check the C# harness performs on its side)
+        assert cls.decode(raw).encode() == raw, name
+        assert raw, f"{name} vector is empty"
+
+
+def test_vectors_are_deterministic():
+    assert emit_vectors() == emit_vectors()
+    a = [raw for _, raw in golden_cases()]
+    b = [raw for _, raw in golden_cases()]
+    assert a == b
+
+
+def test_harness_replays_every_message():
+    harness = emit_cs_harness()
+    for cls in _collect():
+        assert f'case "{cls.__name__}":' in harness
+        assert f"new NFMsg.{cls.__name__}()" in harness
+    assert harness.count("{") == harness.count("}")
+
+
+def test_committed_artifacts_are_fresh():
+    """clients/unity/ must match what the emitters produce today —
+    a drifted binding or vector file is a silent wire break."""
+    assert (UNITY / "NFMsgGolden.tsv").read_text() == emit_vectors()
+    assert (UNITY / "NFMsgGoldenTest.cs").read_text() == emit_cs_harness()
+    from noahgameframe_tpu.tools.emit_cs_sdk import emit_cs
+
+    assert (UNITY / "NFMsg.cs").read_text() == emit_cs()

@@ -1,0 +1,100 @@
+"""Per-observer interest queries + quantized delta filter (ops/interest):
+the device side of per-session AOI sync (SURVEY §3.3 served path)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from noahgameframe_tpu.ops.interest import (
+    QMAX,
+    quantize_delta,
+    visible_candidates,
+)
+
+
+def test_quantize_delta_basics():
+    extent = 512.0
+    pos = jnp.array([[0.0, 0.0, 0.0], [256.0, 256.0, 0.0], [512.0, 0.0, 0.0]])
+    alive = jnp.array([True, True, False])
+    last = jnp.full((3, 3), -1, jnp.int32)
+    q, moved, new_last = quantize_delta(pos, alive, last, extent)
+    q = np.asarray(q)
+    assert q[0].tolist() == [0, 0, 0]
+    assert q[1][0] == round(256.0 / 512.0 * QMAX)
+    assert q[2][0] == QMAX  # clipped at extent
+    # first sync: everything alive moves (last=-1 can't match)
+    assert np.asarray(moved).tolist() == [True, True, False]
+    # dead row keeps its stale last (never synced)
+    assert np.asarray(new_last)[2].tolist() == [-1, -1, -1]
+
+
+def test_quantum_drift_accumulates():
+    extent = 655.35  # quantum = extent/QMAX = 0.01
+    p0 = jnp.array([[100.0, 100.0, 0.0]])
+    alive = jnp.array([True])
+    q0, moved, last = quantize_delta(p0, alive, jnp.full((1, 3), -1, jnp.int32), extent)
+    assert bool(np.asarray(moved)[0])
+    # drift less than half a quantum: not moved, last unchanged
+    p1 = p0 + 0.004
+    q1, moved1, last1 = quantize_delta(p1, alive, last, extent)
+    assert not bool(np.asarray(moved1)[0])
+    # drift again: total displacement crosses the quantum vs LAST SYNC
+    p2 = p0 + 0.008
+    q2, moved2, _ = quantize_delta(p2, alive, last1, extent)
+    assert bool(np.asarray(moved2)[0])
+
+
+def _brute(pos, moved, scene, group, obs, obs_scene, obs_group, radius):
+    out = []
+    for j in range(len(obs)):
+        vis = set()
+        for i in range(len(pos)):
+            if not moved[i] or scene[i] != obs_scene[j]:
+                continue
+            # reference scoping: group 0 = scene-wide, else same group
+            if group[i] != 0 and group[i] != obs_group[j]:
+                continue
+            d = pos[i, :2] - obs[j, :2]
+            if float(d @ d) <= radius * radius:
+                vis.add(i)
+        out.append(vis)
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_visible_matches_bruteforce(seed):
+    rng = np.random.default_rng(seed)
+    n, s, extent, radius = 400, 17, 64.0, 6.0
+    pos = rng.uniform(0, extent, (n, 2)).astype(np.float32)
+    moved = rng.random(n) < 0.7
+    scene = rng.integers(1, 3, n).astype(np.float32)
+    group = rng.integers(0, 3, n).astype(np.float32)  # 0 = scene-wide
+    obs = rng.uniform(0, extent, (s, 2)).astype(np.float32)
+    obs_scene = rng.integers(1, 3, s).astype(np.float32)
+    obs_group = rng.integers(1, 3, s).astype(np.float32)
+    width = int(extent // radius)
+    res = visible_candidates(
+        jnp.asarray(pos), jnp.asarray(moved),
+        jnp.asarray(scene), jnp.asarray(group),
+        jnp.asarray(obs), jnp.asarray(obs_scene), jnp.asarray(obs_group),
+        radius=radius, cell_size=radius, width=width, bucket=64,
+    )
+    rows, ok = np.asarray(res.rows), np.asarray(res.ok)
+    want = _brute(pos, moved, scene, group, obs, obs_scene, obs_group, radius)
+    for j in range(s):
+        got = set(rows[j][ok[j]].tolist())
+        assert got == want[j], f"observer {j}"
+
+
+def test_visible_respects_moved_mask():
+    pos = jnp.array([[10.0, 10.0], [10.5, 10.5]])
+    moved = jnp.array([True, False])
+    res = visible_candidates(
+        pos, moved, jnp.ones(2), jnp.ones(2),
+        jnp.array([[10.0, 10.0]]), jnp.ones(1), jnp.ones(1),
+        radius=4.0, cell_size=4.0, width=8, bucket=8,
+    )
+    rows, ok = np.asarray(res.rows), np.asarray(res.ok)
+    assert set(rows[0][ok[0]].tolist()) == {0}

@@ -1,0 +1,176 @@
+"""Interest-filtered served path: per-session Position streams replace
+group-wide broadcast — each client sees only nearby entities, quantized,
+with a >=10x byte cut at density (round-3 verdict item 3)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from noahgameframe_tpu.core.datatypes import next_pow2
+from noahgameframe_tpu.game import build_benchmark_world
+from noahgameframe_tpu.net.defines import MsgID
+from noahgameframe_tpu.net.roles.base import RoleConfig
+from noahgameframe_tpu.net.roles.game import GameRole, Session
+from noahgameframe_tpu.net.wire import (
+    Ident,
+    InterestPosSync,
+    MsgBase,
+    ident_key,
+)
+from noahgameframe_tpu.ops.interest import QMAX
+
+N, SESSIONS, RADIUS = 4000, 16, 8.0
+
+
+def make_role(interest_radius):
+    world = build_benchmark_world(
+        N, combat=False, seed=7,
+        player_capacity=next_pow2(SESSIONS + 8, lo=64),
+    )
+    role = GameRole(
+        RoleConfig(6, 0, "IntGame", "127.0.0.1", 0),
+        backend="py",
+        world=world,
+        cross_server_sync=False,
+        interest_radius=interest_radius,
+    )
+    sent = []
+
+    def fake_send(conn_id, msg_id, body):
+        sent.append((conn_id, msg_id, body))
+        return True
+
+    role.server.send_raw = fake_send
+    rng = np.random.default_rng(3)
+    ext = world.config.extent
+    for i in range(SESSIONS):
+        ident = Ident(svrid=99, index=i + 1)
+        sess = Session(ident=ident, conn_id=2000 + i, account=f"bot{i}")
+        g = role.kernel.create_object(
+            "Player", {"Name": f"Bot{i}"}, scene=1, group=0
+        )
+        role.kernel.set_property(
+            g, "Position",
+            (float(rng.uniform(0, ext)), float(rng.uniform(0, ext)), 0.0),
+        )
+        sess.guid = g
+        role.sessions[ident_key(ident)] = sess
+        role._guid_session[g] = ident_key(ident)
+    return role, world, sent
+
+
+def run_frames(role, world, n_frames=3):
+    dt = world.config.dt * 1.0001
+    now = 1000.0
+    for _ in range(n_frames):
+        now += dt
+        role.execute(now)
+    return now
+
+
+def test_interest_stream_bytes_vs_broadcast():
+    """>=10x fewer sync bytes than the group-broadcast lane on the same
+    world/session geometry."""
+    role_b, world_b, sent_b = make_role(interest_radius=None)
+    run_frames(role_b, world_b)
+    bytes_b = sum(len(b) for c, m, b in sent_b
+                  if m == int(MsgID.ACK_BATCH_PROPERTY))
+
+    role_i, world_i, sent_i = make_role(interest_radius=RADIUS)
+    run_frames(role_i, world_i)
+    pos_msgs = [b for c, m, b in sent_i if m == int(MsgID.ACK_INTEREST_POS)]
+    bytes_i = sum(len(b) for b in pos_msgs)
+    assert pos_msgs, "interest stream produced no messages"
+    assert bytes_b > 0
+    assert bytes_i * 10 <= bytes_b, (bytes_i, bytes_b)
+
+
+def test_interest_stream_contents_are_nearby_and_accurate():
+    role, world, sent = make_role(interest_radius=RADIUS)
+    run_frames(role, world, n_frames=2)
+    k = role.kernel
+    ext = world.config.extent
+    quantum = ext / QMAX
+    hosts = [k.store._hosts["NPC"], k.store._hosts["Player"]]
+    # map conn -> session avatar position
+    conn_pos = {}
+    for sess in role.sessions.values():
+        conn_pos[sess.conn_id] = np.asarray(
+            k.get_property(sess.guid, "Position")
+        )
+    checked = 0
+    for conn_id, msg_id, body in sent:
+        if msg_id != int(MsgID.ACK_INTEREST_POS):
+            continue
+        base = MsgBase.decode(body)
+        msg = InterestPosSync.decode(base.msg_data)
+        heads = np.frombuffer(msg.svrid, np.int64)
+        datas = np.frombuffer(msg.index, np.int64)
+        qpos = np.frombuffer(msg.qpos, np.uint16).reshape(-1, 3)
+        assert msg.count == len(heads) == len(qpos)
+        avatar = conn_pos[conn_id]
+        for h, d_, qp in zip(heads.tolist(), datas.tolist(), qpos.tolist()):
+            # entity must actually BE near the avatar (within radius +
+            # one tick of movement drift) and the dequantized position
+            # must match the entity's device position to the quantum
+            g = None
+            for host in hosts:
+                rows = np.flatnonzero((host.guid_head == h)
+                                      & (host.guid_data == d_))
+                if rows.size:
+                    g = host.row_guid[int(rows[0])]
+                    break
+            assert g is not None
+            true_pos = np.asarray(k.get_property(g, "Position"))
+            deq = np.asarray(qp, np.float64) * float(msg.scale)
+            # quantization error: half a quantum per axis + movement
+            # between the synced frame and now
+            move_per_tick = 2.0  # bench world speeds are small
+            assert np.all(np.abs(deq[:2] - true_pos[:2])
+                          <= quantum + 2 * move_per_tick)
+            d = true_pos[:2] - avatar[:2]
+            assert float(np.hypot(d[0], d[1])) <= RADIUS + 2 * move_per_tick
+            checked += 1
+    assert checked > 0
+
+
+def test_far_entities_never_stream():
+    """A session parked in an empty corner receives no interest traffic
+    for the crowd (the broadcast lane would have sent it everything)."""
+    role, world, sent = make_role(interest_radius=RADIUS)
+    # move every NPC into the far corner, away from all avatars? cheaper:
+    # park ONE extra session far outside every NPC's reach
+    ident = Ident(svrid=99, index=777)
+    sess = Session(ident=ident, conn_id=7777, account="corner")
+    g = role.kernel.create_object("Player", {"Name": "corner"},
+                                  scene=1, group=0)
+    # beyond the grid: clipped into the border cell; park well inside a
+    # corner that the uniform world still populates sparsely -> place at
+    # a spot then verify against actual distances below
+    role.kernel.set_property(g, "Position", (0.25, 0.25, 0.0))
+    sess.guid = g
+    role.sessions[ident_key(ident)] = sess
+    role._guid_session[g] = ident_key(ident)
+    run_frames(role, world, n_frames=2)
+    k = role.kernel
+    hosts = [k.store._hosts["NPC"], k.store._hosts["Player"]]
+    for conn_id, msg_id, body in sent:
+        if msg_id != int(MsgID.ACK_INTEREST_POS) or conn_id != 7777:
+            continue
+        base = MsgBase.decode(body)
+        msg = InterestPosSync.decode(base.msg_data)
+        heads = np.frombuffer(msg.svrid, np.int64)
+        datas = np.frombuffer(msg.index, np.int64)
+        for h, d_ in zip(heads.tolist(), datas.tolist()):
+            gg = None
+            for host in hosts:
+                rows = np.flatnonzero((host.guid_head == h)
+                                      & (host.guid_data == d_))
+                if rows.size:
+                    gg = host.row_guid[int(rows[0])]
+                    break
+            assert gg is not None
+            p = np.asarray(k.get_property(gg, "Position"))
+            d = float(np.hypot(p[0] - 0.25, p[1] - 0.25))
+            assert d <= RADIUS + 4.0  # nearby only, never the far crowd

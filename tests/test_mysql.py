@@ -1,0 +1,210 @@
+"""MySQL wire protocol: handshake, native-password auth, COM_QUERY text
+resultsets — client and MiniMysql server twin over real sockets
+(reference NFMysqlPlugin / NFCMysqlDriver.cpp, SURVEY §2.6)."""
+
+from __future__ import annotations
+
+import hashlib
+
+import pytest
+
+from noahgameframe_tpu.persist.mysql import (
+    MiniMysql,
+    MysqlClient,
+    MysqlError,
+    MysqlModule,
+    _mysql_to_sqlite,
+    scramble_native,
+)
+from noahgameframe_tpu.persist.sql import (
+    SqlDriver,
+    SqlDriverManager,
+    SqlServerConfig,
+)
+
+
+@pytest.fixture()
+def server():
+    srv = MiniMysql(user="game", password="s3cret")
+    yield srv
+    srv.close()
+
+
+def connect(srv, **kw):
+    args = dict(user="game", password="s3cret")
+    args.update(kw)
+    return MysqlClient(srv.host, srv.port, **args)
+
+
+# -- primitives --------------------------------------------------------------
+
+
+def test_scramble_native_shape():
+    salt = bytes(range(20))
+    s = scramble_native("pw", salt)
+    assert len(s) == 20
+    assert s == scramble_native("pw", salt)  # deterministic
+    assert s != scramble_native("pw2", salt)
+    assert scramble_native("", salt) == b""
+    # spot-check the formula independently
+    h1 = hashlib.sha1(b"pw").digest()
+    h3 = hashlib.sha1(salt + hashlib.sha1(h1).digest()).digest()
+    assert s == bytes(a ^ b for a, b in zip(h1, h3))
+
+
+def test_dialect_shim():
+    assert _mysql_to_sqlite("SHOW COLUMNS FROM `t`") == 'PRAGMA table_info("t")'
+    up = ("INSERT INTO `t` (`id`, `a`) VALUES ('k', 'v') "
+          "ON DUPLICATE KEY UPDATE `a`=VALUES(`a`)")
+    assert _mysql_to_sqlite(up) == (
+        'INSERT INTO "t" ("id", "a") VALUES (\'k\', \'v\') '
+        'ON CONFLICT("id") DO UPDATE SET "a"=excluded."a"'
+    )
+    # backslash escapes inside literals become doubled-quote escapes;
+    # backticks outside literals become double quotes
+    assert _mysql_to_sqlite(r"SELECT 'o\'brien\\x' FROM `t`") == (
+        "SELECT 'o''brien\\x' FROM \"t\""
+    )
+
+
+# -- wire-level client/server ------------------------------------------------
+
+
+def test_handshake_and_ping(server):
+    cli = connect(server)
+    assert cli.server_version.startswith("5.7")
+    assert cli.ping()
+    cli.close()
+
+
+def test_wrong_password_rejected(server):
+    with pytest.raises(MysqlError) as ei:
+        connect(server, password="nope")
+    assert ei.value.code == 1045
+
+
+def test_wrong_user_rejected(server):
+    with pytest.raises(MysqlError):
+        connect(server, user="intruder")
+
+
+def test_query_roundtrip_with_hostile_values(server):
+    cli = connect(server)
+    cli.query("CREATE TABLE t (id TEXT PRIMARY KEY, v TEXT)")
+    hostile = "o'brien \\ \"x\"\nline2"
+    lit = hostile.replace("\\", "\\\\").replace("'", "\\'").replace("\n", "\\n")
+    cli.query(f"INSERT INTO t VALUES ('k1', '{lit}')")
+    names, rows = cli.query("SELECT v FROM t WHERE id = 'k1'")
+    assert names == ["v"]
+    assert rows == [[hostile]]
+    cli.close()
+
+
+def test_error_packet_raises(server):
+    cli = connect(server)
+    with pytest.raises(MysqlError) as ei:
+        cli.query("SELECT * FROM missing_table")
+    assert ei.value.code == 1064
+    # connection still usable after an ERR
+    assert cli.ping()
+    cli.close()
+
+
+def test_null_values_in_resultset(server):
+    cli = connect(server)
+    cli.query("CREATE TABLE n (id TEXT PRIMARY KEY, a TEXT, b TEXT)")
+    cli.query("INSERT INTO n (id, a) VALUES ('k', 'x')")
+    _, rows = cli.query("SELECT a, b FROM n WHERE id='k'")
+    assert rows == [["x", None]]
+    cli.close()
+
+
+# -- reference table API over the wire --------------------------------------
+
+
+def test_module_surface(server):
+    m = MysqlModule(server.host, server.port, "game", "s3cret")
+    assert m.updata("player", "ann", ["Name", "Gold"], ["Ann O'Hara", 5])
+    assert m.updata("player", "bob", ["Name"], ["Bob"])
+    # text protocol: everything comes back as strings
+    assert m.query("player", "ann", ["Gold", "Name"]) == ["5", "Ann O'Hara"]
+    assert m.select("player", "ann") == {"Name": "Ann O'Hara", "Gold": "5"}
+    assert m.exists("player", "ann") and not m.exists("player", "zed")
+    assert m.keys("player") == ["ann", "bob"]
+    assert m.keys("player", "a%") == ["ann"]
+    # partial-field upsert must PRESERVE untouched columns (real MySQL
+    # ON DUPLICATE KEY semantics — a REPLACE-based shim would null Name)
+    assert m.updata("player", "ann", ["Gold"], [9])
+    assert m.query("player", "ann", ["Gold"]) == ["9"]
+    assert m.query("player", "ann", ["Name"]) == ["Ann O'Hara"]
+    assert m.delete("player", "ann")
+    assert not m.exists("player", "ann")
+    assert m.ping()
+    m.close()
+
+
+def test_data_survives_reconnect(server):
+    m1 = MysqlModule(server.host, server.port, "game", "s3cret")
+    m1.updata("acct", "k", ["F"], ["v"])
+    m1.close()
+    m2 = MysqlModule(server.host, server.port, "game", "s3cret")
+    assert m2.query("acct", "k", ["F"]) == ["v"]
+    m2.close()
+
+
+# -- SqlDriver engine selection + keepalive ---------------------------------
+
+
+def test_driver_selects_mysql_engine(server):
+    cfg = SqlServerConfig(
+        server_id=1, db_name="game_db", ip=server.host, port=server.port,
+        user="game", password="s3cret",
+    )
+    drv = SqlDriver(cfg)
+    assert drv.connect()
+    assert isinstance(drv.module, MysqlModule)
+    assert drv.keep_alive(now=0.0)
+    drv.module.updata("t", "k", ["f"], ["v"])
+    assert drv.module.query("t", "k", ["f"]) == ["v"]
+    drv._drop_module()
+
+
+def test_driver_detects_dead_server_and_reconnects():
+    srv = MiniMysql(user="game", password="pw")
+    cfg = SqlServerConfig(
+        server_id=1, db_name="", ip=srv.host, port=srv.port,
+        user="game", password="pw", reconnect_time=0.0,
+    )
+    drv = SqlDriver(cfg)
+    assert drv.connect()
+    srv.close()
+    assert not drv.keep_alive(now=1.0)  # ping fails -> DISCONNECTED
+    # server returns on the same port
+    srv2 = MiniMysql(user="game", password="pw", port=srv.port)
+    try:
+        assert drv.keep_alive(now=2.0)  # reconnects
+        assert drv.module.ping()
+    finally:
+        drv._drop_module()
+        srv2.close()
+
+
+def test_driver_manager_routes_to_mysql(server):
+    mgr = SqlDriverManager()
+    mgr.add_server(SqlServerConfig(
+        server_id=7, db_name="", ip=server.host, port=server.port,
+        user="game", password="s3cret",
+    ))
+    assert mgr.updata("guild", "g1", ["Name"], ["Alliance"])
+    assert mgr.select("guild", "g1") == {"Name": "Alliance"}
+    mgr.close()
+
+
+def test_upsert_marker_inside_value_literal(server):
+    """A data value containing ' ON DUPLICATE KEY UPDATE ' must not split
+    the rewritten statement (the clause finder skips string literals)."""
+    m = MysqlModule(server.host, server.port, "game", "s3cret")
+    evil = "x ON DUPLICATE KEY UPDATE y"
+    assert m.updata("t", "k", ["f"], [evil])
+    assert m.query("t", "k", ["f"]) == [evil]
+    m.close()

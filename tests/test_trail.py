@@ -119,3 +119,42 @@ def test_destroyed_object_releases_trail_and_recycled_row_is_untracked():
     n = len(log.lines)
     kernel.set_property(g2, "HP", 3)
     assert len(log.lines) == n
+
+
+def test_trail_sees_unflagged_property_tick_changes():
+    """Properties without public/upload flags are normally outside diff
+    extraction; the trail must opt them in (force_diff_property) so
+    device-tick changes to them are logged too."""
+    from noahgameframe_tpu.kernel import Module
+
+    class PokeRegen(Module):
+        name = "PokeRegen"
+
+        def init(self):
+            self.add_phase("poke", self.phase, order=10)
+
+        def phase(self, state, ctx):
+            spec = ctx.store.spec("NPC")
+            col = spec.slots["HPREGEN"].col  # no public/upload flag
+            cs = state.classes["NPC"]
+            return state.replace(classes={
+                **state.classes,
+                "NPC": cs.replace(i32=cs.i32.at[:, col].set(13)),
+            })
+
+    log = CaptureLog()
+    pm = PluginManager()
+    kernel = Kernel(
+        base_registry(),
+        StoreConfig(default_capacity=16, capacities={"NPC": 16, "Player": 16}),
+        dt=1.0,
+        class_names=["IObject", "Player", "NPC"],
+    )
+    trail = PropertyTrailModule(logger=log)
+    pm.register_plugin(Plugin("TrailPlugin", [kernel, trail, PokeRegen()]))
+    pm.start()
+    g = kernel.create_object("NPC", {"HPREGEN": 1})
+    trail.start_trail(g)
+    n = len(log.lines)
+    pm.run_once()
+    assert any("NPC.HPREGEN -> 13" in ln for ln in log.lines[n:])
