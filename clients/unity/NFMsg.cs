@@ -5943,6 +5943,10 @@ namespace NFMsg
         public bool HasIndex = false;
         public byte[] qpos = Nf.Empty;
         public bool HasQpos = false;
+        public byte[] gone_svrid = Nf.Empty;
+        public bool HasGoneSvrid = false;
+        public byte[] gone_index = Nf.Empty;
+        public bool HasGoneIndex = false;
         public void Encode(MemoryStream nf__o)
         {
             if (HasScale)
@@ -5970,6 +5974,16 @@ namespace NFMsg
                 Nf.PutTag(nf__o, 5, 2);
                 Nf.PutBytes(nf__o, qpos);
             }
+            if (HasGoneSvrid)
+            {
+                Nf.PutTag(nf__o, 6, 2);
+                Nf.PutBytes(nf__o, gone_svrid);
+            }
+            if (HasGoneIndex)
+            {
+                Nf.PutTag(nf__o, 7, 2);
+                Nf.PutBytes(nf__o, gone_index);
+            }
         }
         public byte[] Encode()
         {
@@ -5987,6 +6001,10 @@ namespace NFMsg
             HasIndex = false;
             qpos = Nf.Empty;
             HasQpos = false;
+            gone_svrid = Nf.Empty;
+            HasGoneSvrid = false;
+            gone_index = Nf.Empty;
+            HasGoneIndex = false;
         }
         public bool Decode(byte[] nf__data, int nf__off, int nf__len)
         {
@@ -6061,6 +6079,32 @@ namespace NFMsg
                         qpos = nf__r.Bytes();
                         if (!nf__r.Ok) return false;
                         HasQpos = true;
+                        break;
+                    }
+                    case 6:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        gone_svrid = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasGoneSvrid = true;
+                        break;
+                    }
+                    case 7:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        gone_index = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasGoneIndex = true;
                         break;
                     }
                     default:

@@ -486,6 +486,13 @@ class GameClient:
             pos = (qp[0] * s, qp[1] * s, qp[2] * s)
             o.properties["Position"] = pos
             o.position = pos
+        # the stream is a delta: entities that left this client's view
+        # arrive in the gone list and are despawned from the mirror
+        for h_, d_ in zip(
+            np.frombuffer(msg.gone_svrid, np.int64).tolist(),
+            np.frombuffer(msg.gone_index, np.int64).tolist(),
+        ):
+            self.objects.pop(_key(Ident(svrid=h_, index=d_)), None)
 
     # ------------------------------------------------------------- gameplay
     def move_to(self, x: float, y: float, z: float = 0.0) -> None:

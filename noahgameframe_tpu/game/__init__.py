@@ -5,11 +5,13 @@ from .buff import BuffModule
 from .combat import ATTACK_TIMER, CombatModule, SkillModule
 from .defines import (
     COMM_PROPERTY_RECORD,
+    EShopType,
     GameEvent,
     ItemSubType,
     ItemType,
     NpcType,
     PropertyGroup,
+    SLGBuildingState,
     STAT_NAMES,
     TaskState,
 )
@@ -23,6 +25,7 @@ from .scene_process import SCENE_TYPE_CLONE, SCENE_TYPE_NORMAL, SceneProcessModu
 from .property_config import PropertyConfigModule
 from .regen import REGEN_TIMER, RegenModule
 from .schema import standard_registry
+from .slg import SLGBuildingModule, SLGShopModule
 from .social import (
     FriendModule,
     GmModule,
@@ -72,6 +75,10 @@ __all__ = [
     "PropertyTrailModule",
     "REGEN_TIMER",
     "RegenModule",
+    "EShopType",
+    "SLGBuildingModule",
+    "SLGBuildingState",
+    "SLGShopModule",
     "STAT_NAMES",
     "SkillModule",
     "WorldConfig",

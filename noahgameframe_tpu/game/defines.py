@@ -111,6 +111,43 @@ class ItemSubType(enum.IntEnum):
     PACK = 7
 
 
+class EShopType(enum.IntEnum):
+    """SLG shop catalogue types (reference EShopType,
+    NFDefine.proto:462-472)."""
+
+    BUILDING = 1
+    GOLD = 2
+    DIAMOND = 3
+    SP = 4
+    EQUIP = 5
+    GEM = 6
+    HERO = 7
+    OTHER = 8
+
+
+class SLGBuildingType(enum.IntEnum):
+    """Building families (reference EBuildingType, NFSLGDefine.proto).
+    Single source of truth — net/wire_families re-exports this."""
+
+    BASE = 0
+    DEFENSE = 1
+    ARMY = 2
+    RESOURCE = 3
+    GUILD = 4
+    TEMPLE = 5
+    NUCLEAR = 6
+
+
+class SLGBuildingState(enum.IntEnum):
+    """Building state machine (reference EBuildingState,
+    NFSLGDefine.proto — EBS_IDLE/BOOST/UPGRADE).  Single source of
+    truth — net/wire_families re-exports this."""
+
+    IDLE = 0
+    BOOST = 1
+    UPGRADE = 2
+
+
 class TaskState(enum.IntEnum):
     """Task lifecycle (reference ETaskState, NFDefine.proto:432-438)."""
 

@@ -83,6 +83,12 @@ def standard_registry(extra: Optional[Iterable[ClassDef]] = None) -> ClassRegist
                 prop("SP", "int", public=True, private=True, save=True),
                 prop("Gold", "int", private=True, save=True, upload=True),
                 prop("Money", "int", private=True, save=True, upload=True),
+                # SLG resource block (reference Property.xlsx SLG columns):
+                # Diamond is a shop cost, Stone/Steel/Gold accrue from
+                # RESOURCE-building collects (game/slg.py)
+                prop("Diamond", "int", private=True, save=True, upload=True),
+                prop("Stone", "int", private=True, save=True, upload=True),
+                prop("Steel", "int", private=True, save=True, upload=True),
                 prop("Account", "string", private=True),
                 prop("ConnectKey", "string", private=True),
                 prop("MAXEXP", "int", public=True, private=True),
@@ -147,6 +153,39 @@ def standard_registry(extra: Optional[Iterable[ClassDef]] = None) -> ClassRegist
                         ("TaskID", "string"),
                         ("TaskStatus", "int"),
                         ("Process", "int"),
+                    ],
+                    private=True,
+                    save=True,
+                ),
+                # SLG city: buildings are row-identified (no per-row GUID
+                # column — the row index rides the wire and restores from
+                # checkpoints; reference BuildingList,
+                # NFCSLGBuildingModule.cpp:71-96).  Times are kernel ticks.
+                record(
+                    "BuildingList",
+                    16,
+                    [
+                        ("BuildingID", "string"),
+                        ("State", "int"),
+                        ("X", "int"),
+                        ("Y", "int"),
+                        ("Z", "int"),
+                        ("StateStartTime", "int"),
+                        ("StateEndTime", "int"),
+                        ("Level", "int"),
+                        ("LastCollect", "int"),
+                    ],
+                    private=True,
+                    save=True,
+                ),
+                record(
+                    "BuildingProduce",
+                    16,
+                    [
+                        ("BuildingRow", "int"),
+                        ("ItemID", "string"),
+                        ("LeftCount", "int"),
+                        ("NextTime", "int"),
                     ],
                     private=True,
                     save=True,
@@ -229,6 +268,36 @@ def standard_registry(extra: Optional[Iterable[ClassDef]] = None) -> ClassRegist
                 prop("HeroTye", "int"),
             ]
             + _stat_props(),
+        )
+    )
+    # SLG config classes (reference NFDataCfg Shop.xlsx / Building rows,
+    # consumed by game/slg.py): a shop row gates on Level, costs
+    # Gold+Diamond, and yields ItemID per EShopType; a building row
+    # carries its upgrade duration
+    reg.define(
+        ClassDef(
+            name="Shop",
+            parent="IObject",
+            properties=[
+                prop("Type", "int"),  # EShopType
+                prop("Level", "int"),
+                prop("Gold", "int"),
+                prop("Diamond", "int"),
+                prop("ItemID", "string"),
+                prop("Count", "int"),
+            ],
+        )
+    )
+    reg.define(
+        ClassDef(
+            name="Building",
+            parent="IObject",
+            properties=[
+                prop("Type", "int"),  # EBuildingType
+                prop("Level", "int"),
+                prop("UpgradeTime", "float"),  # seconds; 0 = module default
+                prop("ProduceTime", "float"),
+            ],
         )
     )
     # per-(job,level) base-stat table rows (reference InitProperty class,

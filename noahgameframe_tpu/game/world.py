@@ -40,6 +40,7 @@ from .defines import COMM_PROPERTY_RECORD, PropertyGroup, STAT_NAMES
 from .level import LevelModule
 from .movement import MovementModule
 from .scene_process import SCENE_TYPE_CLONE, SCENE_TYPE_NORMAL, SceneProcessModule  # noqa: F401
+from .slg import SLGBuildingModule, SLGShopModule
 from .property_config import PropertyConfigModule
 from .regen import RegenModule
 from .schema import standard_registry
@@ -100,6 +101,7 @@ class GameWorld:
         self.pack = self.items = self.equip = self.heroes = self.tasks = None
         self.buffs = self.team = self.mail = self.rank = self.shop = None
         self.friends = self.guilds = self.gm = self.pvp = None
+        self.slg_building = self.slg_shop = None
         if cfg.middleware:
             self.pack = PackModule()
             self.items = ItemModule(self.pack)
@@ -115,10 +117,12 @@ class GameWorld:
             self.guilds = GuildModule()
             self.gm = GmModule(self.level, self.pack)
             self.pvp = PvpMatchModule()
+            self.slg_building = SLGBuildingModule(self.pack)
+            self.slg_shop = SLGShopModule(self.pack, self.slg_building)
             modules += [self.pack, self.items, self.equip, self.heroes,
                         self.tasks, self.buffs, self.team, self.mail,
                         self.rank, self.shop, self.friends, self.guilds,
-                        self.gm, self.pvp]
+                        self.gm, self.pvp, self.slg_building, self.slg_shop]
         self.movement = None
         self.combat = None
         self.regen = None

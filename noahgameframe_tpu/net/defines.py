@@ -159,6 +159,15 @@ class MsgID(enum.IntEnum):
     ACK_ONLINE_NOTIFY = 1290
     ACK_OFFLINE_NOTIFY = 1291
 
+    # SLG city building (NFDefine.proto:292-299 EGMI_REQ_BUY_FORM_SHOP..)
+    REQ_BUY_FORM_SHOP = 20000
+    ACK_BUY_FORM_SHOP = 20001
+    REQ_MOVE_BUILD_OBJECT = 20002
+    ACK_MOVE_BUILD_OBJECT = 20003
+    REQ_UP_BUILD_LVL = 20101
+    REQ_CREATE_ITEM = 20102
+    REQ_BUILD_OPERATE = 20103
+
 
 #: Reference cadence constants (NFINetClientModule.hpp:349,397)
 KEEPALIVE_SECONDS = 10.0

@@ -141,7 +141,10 @@ class GameRole(ServerRole):
         # group is busy (round-3: 24.5 MB/frame at 100k / 500 sessions)
         self.interest_radius = interest_radius
         self._interest_jit: Dict[Tuple[str, int], object] = {}
-        self._interest_lastq: Dict[str, object] = {}
+        # classes with a create/destroy since the last interest flush
+        # (visible sets can change without any Position diff)
+        self._interest_dirty: set = set()
+        self._last_obs_sig: Optional[tuple] = None
         self.game_world = world if world is not None else GameWorld(
             WorldConfig(combat=False, movement=False, regen=True)
         ).start()
@@ -231,6 +234,15 @@ class GameRole(ServerRole):
                     self.kernel.register_record_diff(
                         cname, rname, self._on_record_diff
                     )
+        if self.interest_radius is not None:
+            # creates/destroys change visible sets without a Position
+            # diff — mark the class dirty so the gated interest flush runs
+            def _mark_dirty(_g: Guid, cn: str, _ev) -> None:
+                self._interest_dirty.add(cn)
+
+            for cname in self.sync_classes:
+                if self._interest_ok(cname):
+                    self.kernel.register_class_event(_mark_dirty, cname)
 
     def _install(self) -> None:
         s = self.server
@@ -243,6 +255,11 @@ class GameRole(ServerRole):
         s.on(MsgID.REQ_MOVE, self._on_move)
         s.on(MsgID.REQ_CHAT, self._on_chat)
         s.on(MsgID.REQ_SKILL_OBJECTX, self._on_skill)
+        s.on(MsgID.REQ_BUY_FORM_SHOP, self._on_slg_buy)
+        s.on(MsgID.REQ_MOVE_BUILD_OBJECT, self._on_slg_move)
+        s.on(MsgID.REQ_UP_BUILD_LVL, self._on_slg_upgrade)
+        s.on(MsgID.REQ_CREATE_ITEM, self._on_slg_create_item)
+        s.on(MsgID.REQ_BUILD_OPERATE, self._on_slg_operate)
         s.on_socket_event(self._on_socket)
 
     def cur_count(self) -> int:
@@ -581,6 +598,88 @@ class GameRole(ServerRole):
             return None
         return Guid(ident.svrid, ident.index)
 
+    # ------------------------------------------------------------ SLG city
+    # reference handlers: NFCSLGShopModule::OnSLGClienBuyItem and
+    # NFCSLGBuildingModule::OnSLGClienMoveObject/UpgradeBuilding/CreateItem
+    def _slg_session(self, base) -> Optional[Session]:
+        sess = self.sessions.get(_ident_key(base.player_id))
+        if sess is None or sess.guid is None:
+            return None
+        if self.game_world.slg_building is None:
+            return None  # world assembled without the middleware stack
+        return sess
+
+    def _on_slg_buy(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        from ..wire_families import ReqAckBuyObjectFormShop
+
+        base, req = unwrap(body, ReqAckBuyObjectFormShop)
+        sess = self._slg_session(base)
+        if sess is None:
+            return
+        shop_id = req.config_id.decode("utf-8", "replace")
+        if self.game_world.slg_shop.buy(sess.guid, shop_id,
+                                        req.x, req.y, req.z):
+            self._send_to_session(sess, MsgID.ACK_BUY_FORM_SHOP, req)
+
+    def _on_slg_move(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        from ..wire_families import ReqAckMoveBuildObject
+
+        base, req = unwrap(body, ReqAckMoveBuildObject)
+        sess = self._slg_session(base)
+        if sess is None or req.row is None:
+            return
+        if self.game_world.slg_building.move(sess.guid, int(req.row),
+                                             req.x, req.y, req.z):
+            self._send_to_session(sess, MsgID.ACK_MOVE_BUILD_OBJECT, req)
+
+    def _on_slg_upgrade(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        from ..wire_families import ReqUpBuildLv
+
+        base, req = unwrap(body, ReqUpBuildLv)
+        sess = self._slg_session(base)
+        if sess is None or req.row is None:
+            return
+        self.game_world.slg_building.upgrade(sess.guid, int(req.row))
+
+    def _on_slg_create_item(self, conn_id: int, _msg_id: int,
+                            body: bytes) -> None:
+        from ..wire_families import ReqCreateItem
+
+        base, req = unwrap(body, ReqCreateItem)
+        sess = self._slg_session(base)
+        if sess is None or req.row is None:
+            return
+        self.game_world.slg_building.produce(
+            sess.guid, int(req.row),
+            req.config_id.decode("utf-8", "replace"), int(req.count) or 1,
+        )
+
+    def _on_slg_operate(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        from ..wire_families import ReqBuildOperate, SLGFuncType
+
+        base, req = unwrap(body, ReqBuildOperate)
+        sess = self._slg_session(base)
+        if sess is None or req.row is None:
+            return
+        b = self.game_world.slg_building
+        ft = int(req.functype)
+        collect = {
+            int(SLGFuncType.COLLECT_GOLD): "Gold",
+            int(SLGFuncType.COLLECT_STONE): "Stone",
+            int(SLGFuncType.COLLECT_STEEL): "Steel",
+            int(SLGFuncType.COLLECT_DIAMOND): "Diamond",
+        }.get(ft)
+        if collect is not None:
+            b.collect(sess.guid, int(req.row), collect)
+            return
+        fn = {
+            int(SLGFuncType.BOOST): b.boost,
+            int(SLGFuncType.LVLUP): b.upgrade,
+            int(SLGFuncType.CANCEL): b.cancel,
+        }.get(ft)
+        if fn is not None:
+            fn(sess.guid, int(req.row))
+
     # ------------------------------------------------------------ tick + sync
     def execute(self, now: Optional[float] = None) -> None:
         now = _time.monotonic() if now is None else now
@@ -594,12 +693,15 @@ class GameRole(ServerRole):
             self.kernel.execute()
             self.kernel.tick()
             pm.frame += 1
-        if self._changed or self._rec_changed:
+        # _interest_dirty alone must also trigger a flush: a destroy with
+        # no property diff still changes visible sets (gone lists)
+        if self._changed or self._rec_changed or self._interest_dirty:
             if self.sessions:
                 self._flush_changes()
             else:
                 self._changed.clear()
                 self._rec_changed.clear()
+                self._interest_dirty.clear()
         # periodic autosave: device-side deaths free the row before any
         # BEFORE_DESTROY hook can run, so the blob must already be fresh
         if (self.data_agent is not None
@@ -884,13 +986,43 @@ class GameRole(ServerRole):
         changed, self._changed = self._changed, {}
         player_idx = self._build_player_index()
         # interest lane: Position diffs of synced classes leave as
-        # per-session interest-filtered streams when a radius is set
+        # per-session interest-filtered streams when a radius is set.
+        # The pipeline only runs when something that can change a visible
+        # set happened — a Position diff in the class, observer movement
+        # (Player Position), an observer set change, or a create/destroy
+        # in the class (the dirty marks) — so an idle world pays nothing.
         self._obs_cache = None  # one _observer_arrays() per flush
         if self.interest_radius is not None:
+            obs_sig = tuple(sorted(
+                (key, s.guid)
+                for key, s in self.sessions.items()
+                if s.guid is not None and s.guid in self.kernel.store.guid_map
+            ))
+            obs_moved = obs_sig != self._last_obs_sig
+            self._last_obs_sig = obs_sig
+
+            def zone_changed(cn: str) -> bool:
+                # visible sets mask on scene+group too — a swap with no
+                # Position diff still changes who sees whom.  These keys
+                # are NOT popped: zone props also ride the normal
+                # broadcast sync.
+                return ((cn, "SceneID") in changed
+                        or (cn, "GroupID") in changed)
+
+            player_moved = ("Player", "Position") in changed \
+                or zone_changed("Player")
             for cname in self.sync_classes:
-                if changed.pop((cname, "Position"), None) is not None:
-                    if self._interest_ok(cname):
-                        self._send_interest_pos(cname)
+                # only claim the diff when the class can ride the interest
+                # lane — non-spatial classes (no SceneID/GroupID) fall
+                # through to the broadcast lanes below
+                if not self._interest_ok(cname):
+                    continue
+                pos_changed = changed.pop((cname, "Position"), None) is not None
+                if (pos_changed or player_moved or obs_moved
+                        or zone_changed(cname)
+                        or cname in self._interest_dirty):
+                    self._interest_dirty.discard(cname)
+                    self._send_interest_pos(cname)
         # columnar fast lane: large public scalar/vector diffs leave as
         # packed-array batches (100k movers = a handful of messages, not
         # 100k python serializations)
@@ -966,9 +1098,15 @@ class GameRole(ServerRole):
 
     def _interest_step(self, cname: str, s_pad: int):
         """Cached per-(class, padded-session-count) jit of the interest
-        pipeline: quantize+delta-gate positions, bin movers into the cell
-        table, read each observer's 3x3 neighborhood, distance+zone mask
-        (ops/interest; the same stencil engine combat runs on)."""
+        pipeline: quantize positions, bin ALL alive in-extent entities into
+        the cell table, read each observer's 3x3 neighborhood, distance+zone
+        mask (ops/interest; the same stencil engine combat runs on).
+
+        Visibility runs over the full alive set — not just movers — so the
+        host can diff each session's visible set against what that session
+        last saw: entities that moved while unobserved and then stopped are
+        re-sent the moment an observer walks into range (the reference's
+        enter-view resend, NFCSceneAOIModule OnObjectListEnter)."""
         key = (cname, s_pad)
         fn = self._interest_jit.get(key)
         if fn is not None:
@@ -976,7 +1114,7 @@ class GameRole(ServerRole):
         import jax
         import jax.numpy as jnp
 
-        from ...ops.interest import quantize_delta, visible_candidates
+        from ...ops.interest import quantize, visible_candidates
         from ...ops.stencil import auto_bucket
 
         k = self.kernel
@@ -992,11 +1130,11 @@ class GameRole(ServerRole):
         cap = k.store.capacity(cname)
         bucket = auto_bucket(cap, width)
 
-        def step(evec, ei32, alive, last_q, pvec, pi32, obs_rows, obs_valid):
+        def step(evec, ei32, alive, pvec, pi32, obs_rows, obs_valid):
             pos3 = evec[:, pos_col]
-            q, moved, new_last = quantize_delta(pos3, alive, last_q, extent)
+            q, in_extent = quantize(pos3, alive, extent)
             res = visible_candidates(
-                pos3, moved,
+                pos3, in_extent,
                 ei32[:, sc_col].astype(jnp.float32),
                 ei32[:, gr_col].astype(jnp.float32),
                 pvec[obs_rows, p_pos][:, :2],
@@ -1004,7 +1142,7 @@ class GameRole(ServerRole):
                 pi32[obs_rows, p_gr].astype(jnp.float32),
                 radius=radius, cell_size=radius, width=width, bucket=bucket,
             )
-            return q, new_last, res.rows, res.ok & obs_valid[:, None]
+            return q, res.rows, res.ok & obs_valid[:, None]
 
         fn = jax.jit(step)
         self._interest_jit[key] = fn
@@ -1088,7 +1226,17 @@ class GameRole(ServerRole):
         carrying only the entities inside its interest radius, positions
         u16-quantized over the scene extent (scale rides the message).
         Replaces the group-broadcast lane for Position when
-        `interest_radius` is set."""
+        `interest_radius` is set.
+
+        Each session carries its OWN seen-state (sorted row array + guid +
+        last-sent quantized position): an entity hits a session's wire
+        when it enters that session's view (first sight or re-entry) or
+        when its quantized position differs from what that session last
+        received.  Leaving view drops the entity from the seen-state, so
+        re-entry resends — the per-observer correctness the reference gets
+        from OnObjectListEnter, without any global last-synced table (and
+        hence no stale-row hazard when rows are recycled: the guid is part
+        of the match)."""
         import jax.numpy as jnp
 
         from ...ops.interest import QMAX
@@ -1102,19 +1250,14 @@ class GameRole(ServerRole):
         if not obs:
             return
 
-        cap = k.store.capacity(cname)
-        last_q = self._interest_lastq.get(cname)
-        if last_q is None:
-            last_q = jnp.full((cap, 3), -1, jnp.int32)
         cs = k.state.classes[cname]
         pcs = k.state.classes["Player"]
         fn = self._interest_step(cname, len(obs_rows))
-        q, new_last, rows, ok = fn(
-            cs.vec, cs.i32, cs.alive, last_q,
+        q, rows, ok = fn(
+            cs.vec, cs.i32, cs.alive,
             pcs.vec, pcs.i32,
             jnp.asarray(obs_rows), jnp.asarray(obs_valid),
         )
-        self._interest_lastq[cname] = new_last
         q_np = np.asarray(q).astype(np.uint16)
         rows_np, ok_np = np.asarray(rows), np.asarray(ok)
         host = k.store._hosts[cname]
@@ -1122,14 +1265,57 @@ class GameRole(ServerRole):
         for i, sess in enumerate(obs):
             vis = rows_np[i][ok_np[i]]
             vis = vis[host.alloc_mask[vis]]  # drop just-died rows
+            seen = getattr(sess, "_interest_seen", None)
+            if seen is None:
+                seen = sess._interest_seen = {}
+            vis = np.sort(vis)
+            heads = host.guid_head[vis]
+            datas = host.guid_data[vis]
+            qv = q_np[vis]  # [n, 3]
+            prev = seen.get(cname)
+            if prev is None:
+                send = np.ones(vis.size, bool)
+                gone_h = gone_d = np.empty(0, np.int64)
+            else:
+                p_rows, p_heads, p_datas, p_q = prev
+                idx = np.searchsorted(p_rows, vis)
+                idx_c = np.minimum(idx, max(len(p_rows) - 1, 0))
+                same = (
+                    (len(p_rows) > 0)
+                    & (p_rows[idx_c] == vis)
+                    & (p_heads[idx_c] == heads)
+                    & (p_datas[idx_c] == datas)
+                    & np.all(p_q[idx_c] == qv, axis=-1)
+                )
+                send = ~same
+                # leave-view: previously-seen guids whose row is gone from
+                # the visible set (or recycled to another guid) — the
+                # delta stream needs an explicit despawn signal
+                if vis.size:
+                    j = np.searchsorted(vis, p_rows)
+                    j_c = np.minimum(j, vis.size - 1)
+                    still = (
+                        (vis[j_c] == p_rows)
+                        & (heads[j_c] == p_heads)
+                        & (datas[j_c] == p_datas)
+                    )
+                else:
+                    still = np.zeros(len(p_rows), bool)
+                gone_h, gone_d = p_heads[~still], p_datas[~still]
             if vis.size == 0:
+                seen.pop(cname, None)
+            else:
+                seen[cname] = (vis, heads, datas, qv)
+            if not send.any() and gone_h.size == 0:
                 continue
             msg = InterestPosSync(
                 scale=scale,
-                count=int(vis.size),
-                svrid=host.guid_head[vis].tobytes(),
-                index=host.guid_data[vis].tobytes(),
-                qpos=np.ascontiguousarray(q_np[vis]).tobytes(),
+                count=int(send.sum()),
+                svrid=heads[send].tobytes(),
+                index=datas[send].tobytes(),
+                qpos=np.ascontiguousarray(qv[send]).tobytes(),
+                gone_svrid=gone_h.tobytes(),
+                gone_index=gone_d.tobytes(),
             )
             self._send_to_session(sess, MsgID.ACK_INTEREST_POS, msg)
 

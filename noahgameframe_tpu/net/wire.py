@@ -635,7 +635,13 @@ class InterestPosSync(Message):
     quantized to u16 over the scene extent (`scale` = extent / 65535 —
     multiply back on the client).  Replaces group-wide Position fan-out
     when the game role runs with an interest radius; guids ride as i64
-    pairs like BatchPropertySync.  qpos holds u16le[n*3]."""
+    pairs like BatchPropertySync.  qpos holds u16le[n*3].
+
+    The stream is a per-session DELTA (only entities this session hasn't
+    seen at this quantized position), so leave-view must be explicit:
+    `gone_svrid`/`gone_index` list the entities that dropped out of this
+    observer's radius (or died) since the last message — the client
+    despawns them (the reference's OnObjectListLeave)."""
 
     FIELDS = [
         (1, "scale", "float", 0.0),
@@ -643,6 +649,8 @@ class InterestPosSync(Message):
         (3, "svrid", "bytes", b""),  # i64le[n]
         (4, "index", "bytes", b""),  # i64le[n]
         (5, "qpos", "bytes", b""),  # u16le[n*3]
+        (6, "gone_svrid", "bytes", b""),  # i64le[m]
+        (7, "gone_index", "bytes", b""),  # i64le[m]
     ]
 
 

@@ -74,14 +74,9 @@ class PackSURLParam(Message):
 # -------------------------------------------------------------- NFSLGDefine
 
 
-class SLGBuildingType(enum.IntEnum):
-    BASE = 0
-    DEFENSE = 1
-    ARMY = 2
-    RESOURCE = 3
-    GUILD = 4
-    TEMPLE = 5
-    NUCLEAR = 6
+# single-source enums: the gameplay layer owns them; the wire layer
+# re-exports so both sides can never diverge on values that ride the wire
+from ..game.defines import SLGBuildingState, SLGBuildingType  # noqa: F401,E402
 
 
 class SLGFuncType(enum.IntEnum):
@@ -99,12 +94,6 @@ class SLGFuncType(enum.IntEnum):
     REPAIR = 11
     CANCEL = 12
     FINISH = 13
-
-
-class SLGBuildingState(enum.IntEnum):
-    IDLE = 0
-    BOOST = 1
-    UPGRADE = 2
 
 
 class ReqAckBuyObjectFormShop(Message):

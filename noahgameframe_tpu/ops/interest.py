@@ -7,10 +7,13 @@ group means full-world fan-out per client (round-3: 24.5 MB/frame of
 position sync at 100k entities / 500 sessions).  This module computes
 *per-session* visible sets the TPU-first way:
 
-1. `quantize_delta` — u16-quantize positions over the scene extent and
-   mask entities whose quantized cell didn't change since last sync
-   (sub-quantum jitter never hits the wire).  One fused elementwise op.
-2. `visible_candidates` — bin the moved entities into the stencil
+1. `quantize` — u16-quantize positions over the scene extent and mask
+   out-of-extent rows.  One fused elementwise op.  Per-session change
+   suppression (send only what THIS observer hasn't seen at this
+   quantum) happens on the host against each session's seen-state
+   (net/roles/game.py `_send_interest_pos`) — a global delta gate can't
+   express enter-view resends.
+2. `visible_candidates` — bin the alive entities into the stencil
    engine's cell table (ops/stencil.build_cell_table, one argsort) and,
    for every observer position, read the 3x3 neighborhood's K slots and
    distance-mask them: [S, 9K] candidate rows in ONE dispatch, no host
@@ -38,26 +41,29 @@ class InterestResult(NamedTuple):
     ok: jnp.ndarray  # [S, 9K] bool — occupied slot AND within radius
 
 
-def quantize_delta(
+def quantize(
     pos: jnp.ndarray,  # [C, >=2] float32 world positions
     alive: jnp.ndarray,  # [C] bool
-    last_q: jnp.ndarray,  # [C, 3] int32 last-synced quantized position
     extent: float,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """(q [C,3] i32, moved [C] bool, new_last [C,3] i32).
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(q [C,3] i32, in_extent [C] bool).
 
-    `moved` = alive AND quantized position differs from the last synced
-    one; new_last advances ONLY for moved rows, so an entity drifting
-    less than one quantum accumulates drift until it crosses it (no
-    stuck-forever error)."""
-    scale = QMAX / extent
+    World-coordinate contract: the stream covers [0, extent] per axis.
+    Rows outside it are NOT clamped onto the boundary (a client would
+    render them pinned at the edge) — they are excluded via the returned
+    mask and simply don't ride the wire until they re-enter the extent.
+    """
     p3 = pos[:, :3] if pos.shape[1] >= 3 else jnp.pad(
         pos, ((0, 0), (0, 3 - pos.shape[1]))
     )
-    q = jnp.clip(jnp.round(p3 * scale), 0, QMAX).astype(jnp.int32)
-    moved = jnp.any(q != last_q, axis=-1) & alive
-    new_last = jnp.where(moved[:, None], q, last_q)
-    return q, moved, new_last
+    # X/Y only: visibility distance is 2D, and Z is client-supplied
+    # (jump/flight jitter) — gating on it would let an entity go
+    # invisible by sending z=-0.5 while staying fully active
+    in_extent = (
+        jnp.all((p3[:, :2] >= 0.0) & (p3[:, :2] <= extent), axis=-1) & alive
+    )
+    q = jnp.clip(jnp.round(p3 * (QMAX / extent)), 0, QMAX).astype(jnp.int32)
+    return q, in_extent
 
 
 def visible_candidates(

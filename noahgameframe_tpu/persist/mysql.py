@@ -219,6 +219,30 @@ class MysqlClient:
         resp += AUTH_PLUGIN + b"\x00"
         self.io.write(resp)
         ok = self.io.read()
+        if ok[0] == 0xFE:
+            # AuthSwitchRequest: plugin name NUL, then fresh auth data.
+            # MySQL 8 sends this when the account's default plugin differs
+            # from what we offered (e.g. caching_sha2_password accounts
+            # that still allow native auth) — re-scramble with the new
+            # salt when the server asks for mysql_native_password, fail
+            # with the plugin's NAME otherwise (not an opaque byte).
+            nul = ok.find(b"\x00", 1)
+            if nul < 0:
+                raise MysqlError(
+                    "malformed AuthSwitchRequest (no plugin terminator; "
+                    "pre-4.1 old-password switch is not supported)"
+                )
+            end = nul
+            plugin = ok[1:end]
+            if plugin != AUTH_PLUGIN:
+                raise MysqlError(
+                    "server requests unsupported auth plugin "
+                    f"{plugin.decode(errors='replace')!r}"
+                    f" (only {AUTH_PLUGIN.decode()} is implemented)"
+                )
+            new_salt = ok[end + 1:].rstrip(b"\x00")
+            self.io.write(scramble_native(password, new_salt))
+            ok = self.io.read()
         if ok[0] == 0xFF:
             raise _parse_err(ok)
         if ok[0] != 0x00:
@@ -543,6 +567,12 @@ class _MiniHandler(socketserver.StreamRequestHandler):
         alen = resp[off]
         off += 1
         auth = resp[off : off + alen]
+        if srv.auth_switch:
+            # exercise the MySQL-8 AuthSwitchRequest path: demand a
+            # re-scramble against a fresh salt before accepting
+            salt = b"jihgfedcba9876543210"
+            io.write(b"\xfe" + AUTH_PLUGIN + b"\x00" + salt + b"\x00")
+            auth = io.read()
         expected = scramble_native(srv.password, salt)
         if user != srv.user or auth != expected:
             io.write(
@@ -632,8 +662,10 @@ class MiniMysql:
     zero dependencies."""
 
     def __init__(self, user: str = "root", password: str = "",
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 auth_switch: bool = False):
         self.user, self.password = user, password
+        self.auth_switch = auth_switch
         self.db = sqlite3.connect(":memory:", check_same_thread=False)
         self.db_lock = threading.Lock()
         self.conns: set = set()

@@ -1,5 +1,7 @@
-"""Per-observer interest queries + quantized delta filter (ops/interest):
-the device side of per-session AOI sync (SURVEY §3.3 served path)."""
+"""Per-observer interest queries + u16 quantization (ops/interest):
+the device side of per-session AOI sync (SURVEY §3.3 served path).
+Per-session change suppression lives in net/roles/game.py and is
+covered by tests/test_interest_served.py."""
 
 from __future__ import annotations
 
@@ -9,41 +11,37 @@ import pytest
 
 from noahgameframe_tpu.ops.interest import (
     QMAX,
-    quantize_delta,
+    quantize,
     visible_candidates,
 )
 
 
-def test_quantize_delta_basics():
+def test_quantize_basics():
     extent = 512.0
     pos = jnp.array([[0.0, 0.0, 0.0], [256.0, 256.0, 0.0], [512.0, 0.0, 0.0]])
     alive = jnp.array([True, True, False])
-    last = jnp.full((3, 3), -1, jnp.int32)
-    q, moved, new_last = quantize_delta(pos, alive, last, extent)
+    q, in_extent = quantize(pos, alive, extent)
     q = np.asarray(q)
     assert q[0].tolist() == [0, 0, 0]
     assert q[1][0] == round(256.0 / 512.0 * QMAX)
-    assert q[2][0] == QMAX  # clipped at extent
-    # first sync: everything alive moves (last=-1 can't match)
-    assert np.asarray(moved).tolist() == [True, True, False]
-    # dead row keeps its stale last (never synced)
-    assert np.asarray(new_last)[2].tolist() == [-1, -1, -1]
+    assert q[2][0] == QMAX  # boundary maps to QMAX exactly
+    # dead rows are masked regardless of position
+    assert np.asarray(in_extent).tolist() == [True, True, False]
 
 
-def test_quantum_drift_accumulates():
-    extent = 655.35  # quantum = extent/QMAX = 0.01
-    p0 = jnp.array([[100.0, 100.0, 0.0]])
-    alive = jnp.array([True])
-    q0, moved, last = quantize_delta(p0, alive, jnp.full((1, 3), -1, jnp.int32), extent)
-    assert bool(np.asarray(moved)[0])
-    # drift less than half a quantum: not moved, last unchanged
-    p1 = p0 + 0.004
-    q1, moved1, last1 = quantize_delta(p1, alive, last, extent)
-    assert not bool(np.asarray(moved1)[0])
-    # drift again: total displacement crosses the quantum vs LAST SYNC
-    p2 = p0 + 0.008
-    q2, moved2, _ = quantize_delta(p2, alive, last1, extent)
-    assert bool(np.asarray(moved2)[0])
+def test_quantize_excludes_out_of_extent():
+    """Rows outside [0, extent] are masked out, NOT clamped onto the
+    boundary (round-4 advisor low finding: a clamped entity would render
+    pinned at the scene edge on the client)."""
+    extent = 100.0
+    pos = jnp.array([
+        [50.0, 50.0, 0.0],
+        [-3.0, 50.0, 0.0],  # negative coordinate
+        [50.0, 104.0, 0.0],  # beyond extent
+    ])
+    alive = jnp.array([True, True, True])
+    _, in_extent = quantize(pos, alive, extent)
+    assert np.asarray(in_extent).tolist() == [True, False, False]
 
 
 def _brute(pos, moved, scene, group, obs, obs_scene, obs_group, radius):

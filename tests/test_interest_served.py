@@ -174,3 +174,173 @@ def test_far_entities_never_stream():
             p = np.asarray(k.get_property(gg, "Position"))
             d = float(np.hypot(p[0] - 0.25, p[1] - 0.25))
             assert d <= RADIUS + 4.0  # nearby only, never the far crowd
+
+
+def _guids_received(sent, conn_id, start=0):
+    got = set()
+    for c, m, body in sent[start:]:
+        if c != conn_id or m != int(MsgID.ACK_INTEREST_POS):
+            continue
+        msg = InterestPosSync.decode(MsgBase.decode(body).msg_data)
+        heads = np.frombuffer(msg.svrid, np.int64)
+        datas = np.frombuffer(msg.index, np.int64)
+        got |= set(zip(heads.tolist(), datas.tolist()))
+    return got
+
+
+def _gones_received(sent, conn_id, start=0):
+    gone = set()
+    for c, m, body in sent[start:]:
+        if c != conn_id or m != int(MsgID.ACK_INTEREST_POS):
+            continue
+        msg = InterestPosSync.decode(MsgBase.decode(body).msg_data)
+        heads = np.frombuffer(msg.gone_svrid, np.int64)
+        datas = np.frombuffer(msg.gone_index, np.int64)
+        gone |= set(zip(heads.tolist(), datas.tolist()))
+    return gone
+
+
+def test_enter_view_resends_stationary_entities():
+    """An entity that moved while unobserved and then STOPPED must still
+    be streamed to an observer who later walks into range — and again on
+    re-entry (the reference's OnObjectListEnter resend; round-4 advisor
+    medium finding on the global delta gate)."""
+    from noahgameframe_tpu.game.world import GameWorld, WorldConfig
+
+    world = GameWorld(WorldConfig(
+        npc_capacity=64, player_capacity=64, extent=64.0,
+        combat=False, movement=False, regen=False, middleware=False,
+    ))
+    world.start()
+    world.scene.create_scene(1, width=64.0)
+    role = GameRole(
+        RoleConfig(6, 0, "EnterGame", "127.0.0.1", 0),
+        backend="py", world=world, cross_server_sync=False,
+        interest_radius=RADIUS,
+    )
+    sent = []
+    role.server.send_raw = lambda c, m, b: (sent.append((c, m, b)), True)[1]
+    k = role.kernel
+
+    ident = Ident(svrid=99, index=1)
+    sess = Session(ident=ident, conn_id=3001, account="walker")
+    av = k.create_object("Player", {"Name": "walker"}, scene=1, group=0)
+    k.set_property(av, "Position", (2.0, 2.0, 0.0))
+    sess.guid = av
+    role.sessions[ident_key(ident)] = sess
+    role._guid_session[av] = ident_key(ident)
+
+    npc = k.create_object("NPC", {}, scene=1, group=0)
+    k.set_property(npc, "Position", (50.0, 50.0, 0.0))
+    host = k.store._hosts["NPC"]
+    row = k.store.row_of(npc)[1]
+    npc_key = (int(host.guid_head[row]), int(host.guid_data[row]))
+
+    dt, now = world.config.dt * 1.0001, 1000.0
+
+    def frame():
+        nonlocal now
+        now += dt
+        role.execute(now)
+
+    frame()
+    assert npc_key not in _guids_received(sent, 3001)
+
+    # npc moves while unobserved, then stops
+    k.set_property(npc, "Position", (52.0, 52.0, 0.0))
+    frame()
+    assert npc_key not in _guids_received(sent, 3001)
+
+    # observer walks next to the (now stationary) npc -> must be streamed
+    n0 = len(sent)
+    k.set_property(av, "Position", (51.0, 51.0, 0.0))
+    frame()
+    assert npc_key in _guids_received(sent, 3001, n0)
+
+    # walk away: npc leaves view -> explicit despawn via the gone list
+    # (the stream is a delta; without this the client would render the
+    # departed entity frozen in place forever)
+    n1 = len(sent)
+    k.set_property(av, "Position", (2.0, 2.0, 0.0))
+    frame()
+    assert npc_key not in _guids_received(sent, 3001, n1)
+    assert npc_key in _gones_received(sent, 3001, n1)
+    # ...then back -> re-entry resends
+    n1b = len(sent)
+    k.set_property(av, "Position", (51.0, 51.0, 0.0))
+    frame()
+    assert npc_key in _guids_received(sent, 3001, n1b)
+
+    # stationary both sides -> nothing re-streams (per-session dedup,
+    # and the idle gate skips the pipeline entirely)
+    n2 = len(sent)
+    frame()
+    assert npc_key not in _guids_received(sent, 3001, n2)
+    assert not any(m == int(MsgID.ACK_INTEREST_POS)
+                   for _, m, _ in sent[n2:])
+
+    # death inside view -> gone (create/destroy marks the class dirty)
+    n3 = len(sent)
+    k.destroy_object(npc)
+    frame()
+    assert npc_key in _gones_received(sent, 3001, n3)
+
+
+def test_group_swap_without_movement_updates_visibility():
+    """A zone change with NO Position diff (enter_scene/group swap) must
+    re-run the interest pipeline: old-group observers get the entity in
+    gone, and swapping back makes it visible again."""
+    from noahgameframe_tpu.game.world import GameWorld, WorldConfig
+    from noahgameframe_tpu.net.roles.base import RoleConfig
+    from noahgameframe_tpu.net.roles.game import GameRole, Session
+
+    world = GameWorld(WorldConfig(
+        npc_capacity=64, player_capacity=64, extent=64.0,
+        combat=False, movement=False, regen=False, middleware=False,
+    ))
+    world.start()
+    world.scene.create_scene(1, width=64.0)
+    role = GameRole(
+        RoleConfig(6, 0, "ZoneGame", "127.0.0.1", 0),
+        backend="py", world=world, cross_server_sync=False,
+        interest_radius=RADIUS,
+    )
+    sent = []
+    role.server.send_raw = lambda c, m, b: (sent.append((c, m, b)), True)[1]
+    k = role.kernel
+
+    ident = Ident(svrid=99, index=1)
+    sess = Session(ident=ident, conn_id=4001, account="zone")
+    av = k.create_object("Player", {"Name": "zone"}, scene=1, group=0)
+    k.set_property(av, "Position", (10.0, 10.0, 0.0))
+    sess.guid = av
+    role.sessions[ident_key(ident)] = sess
+    role._guid_session[av] = ident_key(ident)
+
+    npc = k.create_object("NPC", {}, scene=1, group=0)  # 0 = scene-wide
+    k.set_property(npc, "Position", (12.0, 12.0, 0.0))
+    host = k.store._hosts["NPC"]
+    row = k.store.row_of(npc)[1]
+    npc_key = (int(host.guid_head[row]), int(host.guid_data[row]))
+
+    dt, now = world.config.dt * 1.0001, 1000.0
+
+    def frame():
+        nonlocal now
+        now += dt
+        role.execute(now)
+
+    frame()
+    assert npc_key in _guids_received(sent, 4001)
+
+    # stationary npc swaps to a group the observer is not in
+    n0 = len(sent)
+    k.set_property(npc, "GroupID", 7)
+    frame()
+    assert npc_key in _gones_received(sent, 4001, n0)
+
+    # ...and back: visible again, with no Position change anywhere
+    n1 = len(sent)
+    k.set_property(npc, "GroupID", 0)
+    frame()
+    assert npc_key in _guids_received(sent, 4001, n1)

@@ -208,3 +208,53 @@ def test_upsert_marker_inside_value_literal(server):
     assert m.updata("t", "k", ["f"], [evil])
     assert m.query("t", "k", ["f"]) == [evil]
     m.close()
+
+
+def test_auth_switch_request_rescrambles():
+    """MySQL-8 style AuthSwitchRequest (0xFE): the client re-scrambles
+    against the fresh salt and the session proceeds normally."""
+    srv = MiniMysql(user="game", password="s3cret", auth_switch=True)
+    try:
+        c = MysqlClient(srv.host, srv.port, "game", "s3cret")
+        names, rows = c.query("SELECT 1 AS one")
+        assert rows == [["1"]]
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_auth_switch_to_unknown_plugin_names_it():
+    """A switch to an unimplemented plugin fails with the plugin's name
+    in the error, not an opaque 'unexpected auth reply'."""
+    import socket as _socket
+    import struct as _struct
+    import threading
+
+    from noahgameframe_tpu.persist.mysql import _CAPS, _PacketIO
+
+    lsock = _socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def serve():
+        conn, _ = lsock.accept()
+        io = _PacketIO(conn)
+        salt = b"0123456789abcdefghij"
+        g = bytes([10]) + b"8.0.0-fake\x00" + _struct.pack("<I", 1)
+        g += salt[:8] + b"\x00" + _struct.pack("<H", _CAPS & 0xFFFF)
+        g += bytes([33]) + _struct.pack("<H", 2)
+        g += _struct.pack("<H", (_CAPS >> 16) & 0xFFFF)
+        g += bytes([21]) + b"\x00" * 10 + salt[8:] + b"\x00"
+        g += b"mysql_native_password\x00"
+        io.write(g)
+        io.read()  # client response
+        io.write(b"\xfecaching_sha2_password\x00freshsalt\x00")
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    with pytest.raises(MysqlError, match="caching_sha2_password"):
+        MysqlClient("127.0.0.1", port, "game", "s3cret")
+    t.join(timeout=5)
+    lsock.close()

@@ -1,0 +1,388 @@
+"""SLG city building: placement, timed upgrade/boost, production, shop
+(reference NFCSLGBuildingModule.cpp / NFCSLGShopModule.cpp, VERDICT r4
+missing #1)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from noahgameframe_tpu.game import (
+    EShopType,
+    GameWorld,
+    ItemType,
+    SLGBuildingState,
+    WorldConfig,
+)
+
+
+@pytest.fixture()
+def world():
+    w = GameWorld(WorldConfig(combat=False, movement=False, regen=False,
+                              npc_capacity=64, player_capacity=8)).start()
+    w.scene.create_scene(1)
+    # fast timers for the test world (records store ticks, dt = 1/30)
+    w.slg_building.upgrade_s = 4 * w.config.dt
+    w.slg_building.produce_interval_s = 3 * w.config.dt
+    return w
+
+
+@pytest.fixture()
+def player(world):
+    g = world.kernel.create_object("Player", {"Name": "B", "Account": "b"},
+                                   scene=1, group=0)
+    world.kernel.set_property(g, "Level", 5)
+    world.kernel.set_property(g, "Gold", 1000)
+    world.kernel.set_property(g, "Diamond", 50)
+    return g
+
+
+def define_slg(world):
+    e = world.kernel.elements
+    e.add_element("Building", "barracks", {"Type": 2})
+    e.add_element("Building", "temple", {"Type": 5, "UpgradeTime": 0.5})
+    e.add_element("Item", "sword_s", {"ItemType": int(ItemType.EQUIP)})
+    e.add_element("Item", "bread", {"ItemType": int(ItemType.ITEM)})
+    e.add_element("Shop", "shop_barracks", {
+        "Type": int(EShopType.BUILDING), "Level": 3,
+        "Gold": 100, "Diamond": 5, "ItemID": "barracks"})
+    e.add_element("Shop", "shop_sword", {
+        "Type": int(EShopType.OTHER), "Level": 1, "Gold": 30,
+        "ItemID": "sword_s"})
+    e.add_element("Shop", "shop_bread", {
+        "Type": int(EShopType.GOLD), "Level": 1, "Gold": 5,
+        "ItemID": "bread"})
+
+
+def ticks(world, n):
+    for _ in range(n):
+        world.tick()
+
+
+# ------------------------------------------------------------- buildings
+
+
+def test_add_upgrade_completes_and_levels(world, player):
+    define_slg(world)
+    b = world.slg_building
+    row = b.add_building(player, "barracks", 3, 4, 0)
+    assert row is not None
+    assert b.buildings(player) == {row: "barracks"}
+    assert b.building_state(player, row) == int(SLGBuildingState.IDLE)
+    assert b.building_level(player, row) == 1
+
+    assert b.upgrade(player, row)
+    assert b.building_state(player, row) == int(SLGBuildingState.UPGRADE)
+    assert not b.upgrade(player, row)  # not idle -> refused
+    ticks(world, 6)
+    assert b.building_state(player, row) == int(SLGBuildingState.IDLE)
+    assert b.building_level(player, row) == 2
+
+
+def test_upgrade_time_from_config(world, player):
+    define_slg(world)
+    b = world.slg_building
+    row = b.add_building(player, "temple", 0, 0, 0)
+    assert b.upgrade(player, row)
+    # temple configures 0.5 s = 15 ticks; after 6 ticks still upgrading
+    ticks(world, 6)
+    assert b.building_state(player, row) == int(SLGBuildingState.UPGRADE)
+    ticks(world, 12)
+    assert b.building_level(player, row) == 2
+
+
+def test_boost_shortens_and_cancel_aborts(world, player):
+    define_slg(world)
+    b = world.slg_building
+    b.upgrade_s = 40 * world.config.dt
+    # boost is only legal DURING an upgrade
+    row = b.add_building(player, "barracks", 0, 0, 0)
+    assert not b.boost(player, row)  # idle -> refused
+    assert b.upgrade(player, row)
+    assert b.boost(player, row)
+    assert b.building_state(player, row) == int(SLGBuildingState.BOOST)
+    assert not b.boost(player, row)  # already boosted -> refused
+
+    # cancel returns to idle without leveling
+    row2 = b.add_building(player, "barracks", 1, 1, 0)
+    assert b.upgrade(player, row2)
+    assert b.cancel(player, row2)
+    assert b.building_state(player, row2) == int(SLGBuildingState.IDLE)
+    ticks(world, 45)
+    assert b.building_level(player, row2) == 1  # cancelled: no level
+    # the boosted build (started 45+ ticks ago at half of 40) completed
+    assert b.building_level(player, row) == 2
+
+
+def test_resource_collect_accrues_over_time(world, player):
+    """RESOURCE buildings yield Stone/Steel/Gold/Diamond per elapsed
+    collect interval (EFT_COLLECT_* functypes); spamming collect yields
+    nothing, and non-resource buildings refuse."""
+    define_slg(world)
+    e = world.kernel.elements
+    e.add_element("Building", "quarry", {"Type": 3})  # RESOURCE
+    b = world.slg_building
+    b.collect_interval_s = 2 * world.config.dt
+    row = b.add_building(player, "quarry", 0, 0, 0)
+    k = world.kernel
+    # nothing accrued at placement — an immediate collect gets nothing
+    assert not b.collect(player, row, "Stone")
+    ticks(world, 2)
+    assert b.collect(player, row, "Stone")
+    assert int(k.get_property(player, "Stone")) == b.collect_amount
+    # spamming right after a collect yields nothing (accrual drained)
+    assert not b.collect(player, row, "Stone")
+    assert int(k.get_property(player, "Stone")) == b.collect_amount
+    # level scales the per-interval yield; 2 intervals accrue
+    k.state = k.store.record_set(k.state, player, "BuildingList", row,
+                                 "Level", 3)
+    ticks(world, 4)
+    assert b.collect(player, row, "Steel")
+    assert int(k.get_property(player, "Steel")) == 3 * b.collect_amount * 2
+    # barracks (ARMY) is not a resource building
+    row2 = b.add_building(player, "barracks", 1, 1, 0)
+    ticks(world, 2)
+    assert not b.collect(player, row2, "Stone")
+    assert not b.collect(player, row, "HP")  # not a resource property
+
+
+def test_produce_time_from_config(world, player):
+    """The Building element's ProduceTime drives the production cadence
+    (the config column must not be dead)."""
+    define_slg(world)
+    e = world.kernel.elements
+    e.add_element("Building", "mill", {"Type": 3,
+                                       "ProduceTime": 6 * world.config.dt})
+    b = world.slg_building  # module default is 3 ticks (fixture)
+    row = b.add_building(player, "mill", 0, 0, 0)
+    assert b.produce(player, row, "bread", 1)
+    ticks(world, 4)  # past the module default, before the config interval
+    assert world.pack.item_count(player, "bread") == 0
+    ticks(world, 3)
+    assert world.pack.item_count(player, "bread") == 1
+
+
+def test_relog_rearms_upgrade_timer(world, tmp_path):
+    """A player who logs out mid-upgrade and logs back in (data-agent
+    load path, NOT a whole-world checkpoint) still completes: the
+    COE_CREATE_FINISH hook re-arms from the record
+    (NFCSLGBuildingModule::OnClassObjectEvent)."""
+    from noahgameframe_tpu.persist.agent import PlayerDataAgent
+    from noahgameframe_tpu.persist.kv import MemoryKV
+
+    define_slg(world)
+    agent = PlayerDataAgent(MemoryKV()).bind(world.kernel)
+    k = world.kernel
+    g = k.create_object("Player", {"Name": "R", "Account": "r"},
+                        scene=1, group=0)
+    k.set_property(g, "Level", 5)
+    b = world.slg_building
+    b.upgrade_s = 5 * world.config.dt
+    row = b.add_building(g, "barracks", 0, 0, 0)
+    assert b.upgrade(g, row)
+    ticks(world, 1)
+    agent.save(g)
+    k.destroy_object(g)
+    ticks(world, 1)
+
+    # relog: same Account+Name key -> records restore inside the COE chain
+    g2 = k.create_object("Player", {"Name": "R", "Account": "r"},
+                         scene=1, group=0)
+    assert b.building_state(g2, row) == int(SLGBuildingState.UPGRADE)
+    ticks(world, 8)
+    assert b.building_state(g2, row) == int(SLGBuildingState.IDLE)
+    assert b.building_level(g2, row) == 2
+
+
+def test_move_building(world, player):
+    define_slg(world)
+    b = world.slg_building
+    row = b.add_building(player, "barracks", 1, 2, 3)
+    assert b.move(player, row, 7, 8, 9)
+    k = world.kernel
+    assert int(k.store.record_get(k.state, player, "BuildingList", row,
+                                  "X")) == 7
+    assert int(k.store.record_get(k.state, player, "BuildingList", row,
+                                  "Y")) == 8
+    assert not b.move(player, 13, 0, 0, 0)  # no such building
+
+
+def test_produce_lands_items_over_time(world, player):
+    define_slg(world)
+    b = world.slg_building
+    row = b.add_building(player, "barracks", 0, 0, 0)
+    assert b.produce(player, row, "bread", 2)
+    assert b.produce_left(player, row, "bread") == 2
+    assert world.pack.item_count(player, "bread") == 0
+    ticks(world, 4)
+    assert world.pack.item_count(player, "bread") == 1
+    assert b.produce_left(player, row, "bread") == 1
+    ticks(world, 4)
+    assert world.pack.item_count(player, "bread") == 2
+    assert b.produce_left(player, row, "bread") == 0
+
+
+def test_building_timers_survive_checkpoint(world, player, tmp_path):
+    """The record is the source of truth: a world saved mid-upgrade
+    resumes and still completes (CheckBuildingStatusEnd semantics)."""
+    define_slg(world)
+    b = world.slg_building
+    b.upgrade_s = 10 * world.config.dt
+    row = b.add_building(player, "barracks", 0, 0, 0)
+    assert b.upgrade(player, row)
+    ticks(world, 2)
+    path = tmp_path / "slg.ckpt"
+    world.save(path)
+
+    w2 = GameWorld(WorldConfig(combat=False, movement=False, regen=False,
+                               npc_capacity=64, player_capacity=8)).start()
+    w2.load(path)
+    b2 = w2.slg_building
+    assert b2.building_state(player, row) == int(SLGBuildingState.UPGRADE)
+    for _ in range(15):
+        w2.tick()
+    assert b2.building_state(player, row) == int(SLGBuildingState.IDLE)
+    assert b2.building_level(player, row) == 2
+
+
+# ------------------------------------------------------------------ shop
+
+
+def test_shop_building_purchase_places_and_charges(world, player):
+    define_slg(world)
+    s = world.slg_shop
+    assert s.buy(player, "shop_barracks", 10, 11, 0)
+    k = world.kernel
+    assert int(k.get_property(player, "Gold")) == 900
+    assert int(k.get_property(player, "Diamond")) == 45
+    blds = world.slg_building.buildings(player)
+    assert list(blds.values()) == ["barracks"]
+
+
+def test_shop_level_gate_and_funds(world, player):
+    define_slg(world)
+    s = world.slg_shop
+    k = world.kernel
+    k.set_property(player, "Level", 2)
+    assert not s.buy(player, "shop_barracks")  # needs level 3
+    k.set_property(player, "Level", 3)
+    k.set_property(player, "Gold", 10)
+    assert not s.buy(player, "shop_barracks")  # can't afford
+    assert int(k.get_property(player, "Diamond")) == 50  # nothing spent
+    k.set_property(player, "Gold", 100)
+    k.set_property(player, "Diamond", 1)
+    assert not s.buy(player, "shop_barracks")  # diamond short
+    assert int(k.get_property(player, "Gold")) == 100  # still nothing spent
+
+
+def test_shop_default_branch_equips_and_items(world, player):
+    define_slg(world)
+    s = world.slg_shop
+    assert s.buy(player, "shop_sword")
+    assert len(world.pack.equips(player)) == 1  # EQUIP -> BagEquipList
+    assert s.buy(player, "shop_bread")
+    assert world.pack.item_count(player, "bread") == 1
+    assert not s.buy(player, "nope")
+
+
+# ------------------------------------------------------- wire handlers
+
+
+def test_slg_wire_handlers_end_to_end():
+    """Client messages drive the SLG modules and the record diff reaches
+    the session (use -> effect -> record sync), VERDICT item 7 shape."""
+    from noahgameframe_tpu.net.defines import MsgID
+    from noahgameframe_tpu.net.roles.base import RoleConfig
+    from noahgameframe_tpu.net.roles.game import GameRole, Session
+    from noahgameframe_tpu.net.transport import EV_MSG, NetEvent
+    from noahgameframe_tpu.net.wire import Ident, ident_key, wrap
+    from noahgameframe_tpu.net.wire_families import (
+        ReqAckBuyObjectFormShop,
+        ReqAckMoveBuildObject,
+        ReqBuildOperate,
+        ReqUpBuildLv,
+        SLGFuncType,
+    )
+
+    world = GameWorld(WorldConfig(combat=False, movement=False, regen=False,
+                                  npc_capacity=64, player_capacity=8)).start()
+    role = GameRole(
+        RoleConfig(6, 0, "SlgGame", "127.0.0.1", 0),
+        backend="py", world=world, cross_server_sync=False,
+    )
+    world.slg_building.upgrade_s = 4 * world.config.dt
+    define_slg(world)
+    sent = []
+    role.server.send_raw = lambda c, m, b: (sent.append((c, m, b)), True)[1]
+    k = role.kernel
+
+    ident = Ident(svrid=9, index=1)
+    sess = Session(ident=ident, conn_id=42, account="slg")
+    g = k.create_object("Player", {"Name": "S"}, scene=1, group=0)
+    k.set_property(g, "Level", 5)
+    k.set_property(g, "Gold", 500)
+    k.set_property(g, "Diamond", 50)
+    sess.guid = g
+    role.sessions[ident_key(ident)] = sess
+    role._guid_session[g] = ident_key(ident)
+
+    def send(msg_id, msg):
+        role.server.dispatch.feed([
+            NetEvent(EV_MSG, 42, int(msg_id), wrap(msg, player_id=ident))
+        ])
+
+    send(MsgID.REQ_BUY_FORM_SHOP,
+         ReqAckBuyObjectFormShop(config_id=b"shop_barracks", x=3.0, y=4.0))
+    assert world.slg_building.buildings(g)  # placed via the wire
+    row = next(iter(world.slg_building.buildings(g)))
+    acks = [m for _, m, _ in sent if m == int(MsgID.ACK_BUY_FORM_SHOP)]
+    assert acks
+
+    send(MsgID.REQ_MOVE_BUILD_OBJECT,
+         ReqAckMoveBuildObject(row=row, x=9.0, y=9.0, z=0.0))
+    assert int(k.store.record_get(k.state, g, "BuildingList", row,
+                                  "X")) == 9
+
+    send(MsgID.REQ_UP_BUILD_LVL, ReqUpBuildLv(row=row))
+    assert world.slg_building.building_state(g, row) == int(
+        SLGBuildingState.UPGRADE)
+    send(MsgID.REQ_BUILD_OPERATE,
+         ReqBuildOperate(row=row, functype=int(SLGFuncType.CANCEL)))
+    assert world.slg_building.building_state(g, row) == int(
+        SLGBuildingState.IDLE)
+
+    # the building record diff reached the owner's session as a private
+    # record-sync message (BuildingList is private+save)
+    now = 1000.0
+    for _ in range(3):
+        now += world.config.dt * 1.0001
+        role.execute(now)
+    assert any(c == 42 for c, m, b in sent
+               if m in (int(MsgID.ACK_ADD_ROW), int(MsgID.ACK_RECORD_INT),
+                        int(MsgID.ACK_OBJECT_RECORD_ENTRY)))
+
+
+def test_relog_does_not_double_produce(world, tmp_path):
+    """Stale heap entries surviving a logout plus the relog re-arm must
+    not double the production rate (the record's NextTime is the truth)."""
+    from noahgameframe_tpu.persist.agent import PlayerDataAgent
+    from noahgameframe_tpu.persist.kv import MemoryKV
+
+    define_slg(world)
+    agent = PlayerDataAgent(MemoryKV()).bind(world.kernel)
+    k = world.kernel
+    g = k.create_object("Player", {"Name": "Q", "Account": "q"},
+                        scene=1, group=0)
+    b = world.slg_building
+    row = b.add_building(g, "barracks", 0, 0, 0)
+    assert b.produce(g, row, "bread", 4)
+    ticks(world, 1)
+    agent.save(g)
+    k.destroy_object(g)  # old heap entries now reference a dead guid...
+    g2 = k.create_object("Player", {"Name": "Q", "Account": "q"},
+                         scene=1, group=0)
+    # ...but a same-process relog with the SAME key restores the records
+    # and re-arms; run long enough for 2 intervals (3 ticks each)
+    ticks(world, 7)
+    assert world.pack.item_count(g2, "bread") == 2  # not 4
+    assert b.produce_left(g2, row, "bread") == 2

@@ -51,7 +51,9 @@ def test_start_trail_dumps_then_follows_changes():
     kernel.set_property(other, "HP", 99)  # untracked object -> silent
     changes = log.lines[n_dump:]
     assert any("Player.HP -> 42" in ln for ln in changes)
-    assert not any("99" in ln for ln in changes)
+    # the untracked object's change must not surface — match on its guid's
+    # formatted line, not a bare substring ("99" can appear in guid digits)
+    assert not any(str(other) in ln for ln in changes)
 
 
 def test_end_trail_stops_logging():

@@ -50,10 +50,19 @@ PB_MODULES = [
     "NFMsgURl_pb2",
     "NFSLGDefine_pb2",
     "NFFleetingDefine_pb2",
+    "nf_tpu_ext_pb2",
 ]
 
-# wire.py messages with no reference counterpart (original extensions)
-OURS_ONLY = {"BatchPropertySync"}
+# Our original extensions (no reference counterpart) carry their own twin
+# schema: noahgameframe_tpu/net/nf_tpu_ext.proto.  Every wire class is
+# cross-validated — nothing is exempt.
+EXT_PROTO = (
+    Path(__file__).resolve().parents[1]
+    / "noahgameframe_tpu"
+    / "net"
+    / "nf_tpu_ext.proto"
+)
+OURS_ONLY = set()
 
 
 @pytest.fixture(scope="module")
@@ -74,8 +83,11 @@ def pb(tmp_path_factory):
         "message ReqSearchToShare", "message ReqShareToStart", 1
     )
     (out / "NFMsgShare.proto").write_text(share)
+    shutil.copy(EXT_PROTO, out / EXT_PROTO.name)
     r = subprocess.run(
-        ["protoc", "-I", str(out), "--python_out", str(out)] + PROTO_FILES,
+        ["protoc", "-I", str(out), "--python_out", str(out)]
+        + PROTO_FILES
+        + [EXT_PROTO.name],
         capture_output=True,
         text=True,
     )
