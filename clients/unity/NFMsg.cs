@@ -6117,6 +6117,110 @@ namespace NFMsg
         }
     }
 
+    public class ReqSetFightHero
+    {
+        public Ident selfid = new Ident();
+        public bool HasSelfid = false;
+        public Ident heroid = new Ident();
+        public bool HasHeroid = false;
+        public int fight_pos = 0;
+        public bool HasFightPos = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasSelfid)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); selfid.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasHeroid)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                var nf__sub = new MemoryStream(); heroid.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasFightPos)
+            {
+                Nf.PutTag(nf__o, 3, 0);
+                Nf.PutI64(nf__o, (long)fight_pos);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            selfid = new Ident();
+            HasSelfid = false;
+            heroid = new Ident();
+            HasHeroid = false;
+            fight_pos = 0;
+            HasFightPos = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        selfid = nf__m; HasSelfid = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        heroid = nf__m; HasHeroid = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        fight_pos = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasFightPos = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
     public class RoleOnlineNotify
     {
         public Ident guild = new Ident();

@@ -66,6 +66,7 @@ public static class NFMsgGoldenTest
             case "ServerHeartBeat": { var m = new NFMsg.ServerHeartBeat(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "BatchPropertySync": { var m = new NFMsg.BatchPropertySync(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "InterestPosSync": { var m = new NFMsg.InterestPosSync(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqSetFightHero": { var m = new NFMsg.ReqSetFightHero(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "RoleOnlineNotify": { var m = new NFMsg.RoleOnlineNotify(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "RoleOfflineNotify": { var m = new NFMsg.RoleOfflineNotify(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "ReqEnterGameServer": { var m = new NFMsg.ReqEnterGameServer(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }

@@ -712,6 +712,17 @@ class EntityStore:
             raw = rec.vec[row, rec_row, slot.col]
         return self.decode(slot.col_def.type, raw)
 
+    def record_used_rows(
+        self, state: WorldState, guid: Guid, record_name: str
+    ) -> List[int]:
+        """Indices of used rows in an entity's record (the shared scan
+        behind row-identified records: heroes, buildings, equips)."""
+        class_name, row = self.row_of(guid)
+        rec = state.classes[class_name].records.get(record_name)
+        if rec is None:
+            return []
+        return [int(r) for r in np.flatnonzero(np.asarray(rec.used[row]))]
+
     def record_find_rows(
         self, state: WorldState, guid: Guid, record_name: str, tag: str, value: Value
     ) -> List[int]:

@@ -29,8 +29,12 @@ from .defines import STAT_NAMES, ItemSubType, ItemType, PropertyGroup
 BAG_ITEMS = "BagItemList"
 BAG_EQUIP = "BagEquipList"
 
-# consume processor: (player guid, item config id) -> success
-ConsumeFn = Callable[[Guid, str], bool]
+# consume processor: (player guid, item config id, target) -> success.
+# `target` is family-specific — a hero record row for card/hero awards,
+# an equip record row for gems, None for self-targeted consumables —
+# mirroring the reference's NFIDataList targetID parameter
+# (NFCItemModule.cpp ConsumeProcess).
+ConsumeFn = Callable[[Guid, str, object], bool]
 
 
 class PackModule(Module):
@@ -141,8 +145,13 @@ class PackModule(Module):
 
 
 class ItemModule(Module):
-    """Use-item pipeline with per-family consume processors
-    (NFCItemModule + the NFC*ConsumeProcessModule family)."""
+    """Use-item pipeline with per-family consume processors — the full
+    NFC*ConsumeProcessModule family (NFCItemModule.cpp dispatch +
+    NFCPotionItem/NFCItemToken/NFCItemEquip/NFCItemGem/NFCItemCard/
+    NFCHeroItem/NFCRebornItem ConsumeProcessModule.cpp).  Several of the
+    reference processors are empty skeletons (gem/equip return 1 with no
+    effect, reborn is commented out); here every family has a real,
+    tested effect — documented per-processor."""
 
     name = "ItemModule"
 
@@ -150,11 +159,18 @@ class ItemModule(Module):
         super().__init__()
         self.pack = pack
         self._processors: Dict[int, ConsumeFn] = {}
+        self.max_sockets = 6
+        # wired by the world assembly; processors degrade gracefully
+        self.heroes = None  # game.hero.HeroModule (card/hero awards)
+        self.equip = None  # game.items.EquipModule (gem refresh)
+        self.level = None  # game.level.LevelModule (EXP items)
 
     def after_init(self) -> None:
-        # default consumable effects (potion/token processors)
         self.register_processor(ItemType.ITEM, self._consume_potion)
         self.register_processor(ItemType.TOKEN, self._consume_token)
+        self.register_processor(ItemType.EQUIP, self._consume_equip)
+        self.register_processor(ItemType.GEM, self._consume_gem)
+        self.register_processor(ItemType.CARD, self._consume_card)
 
     def register_processor(self, item_type: int, fn: ConsumeFn) -> None:
         """Attach a family processor (the GetConsumeModule dispatch)."""
@@ -164,9 +180,10 @@ class ItemModule(Module):
         elems = self.kernel.elements
         return elems.element(config_id) if elems.exists(config_id) else None
 
-    def use_item(self, guid: Guid, config_id: str) -> bool:
+    def use_item(self, guid: Guid, config_id: str, target=None) -> bool:
         """ConsumeLegal (owned + processor exists) → ConsumeProcess →
-        remove one from the bag (`NFCItemModule::OnClientUseItem`)."""
+        remove one from the bag (`NFCItemModule::OnClientUseItem`).
+        `target` routes to the family processor (hero row, equip row)."""
         e = self._item_config(config_id)
         if e is None:
             return False
@@ -175,18 +192,32 @@ class ItemModule(Module):
         fn = self._processors.get(int(e.values.get("ItemType", -1)))
         if fn is None:
             return False
-        if not fn(guid, config_id):
+        if not fn(guid, config_id, target):
             return False
         return self.pack.delete_item(guid, config_id, 1)
 
-    # ------------------------------------------------ default processors
-    def _consume_potion(self, guid: Guid, config_id: str) -> bool:
-        """ITEM family: HP/MP/SP waters restore the matching pool
-        (NFCPotionItemConsumeProcessModule)."""
+    # ------------------------------------------------ family processors
+    def _consume_potion(self, guid: Guid, config_id: str, target) -> bool:
+        """ITEM family (NFCPotionItemConsumeProcessModule): HP/MP/SP
+        waters restore the matching pool — an HP water used at 0 HP
+        revives (NFCRebornItemConsumeProcessModule's intent; its body is
+        commented out in the reference).  EXP items award player exp;
+        with a hero-row target the award goes to the hero instead
+        (NFCHeroItemConsumeProcessModule::AwardItemProperty — the
+        EIT_HERO_STONE type it registers under does not exist in the
+        shipped EItemType enum, so that module is dead code in the
+        reference; its targeted-award behavior lives here)."""
         e = self._item_config(config_id)
         sub = int(e.values.get("ItemSubType", -1))
         amount = int(e.values.get("AwardValue", 0))
         k = self.kernel
+        if sub == int(ItemSubType.EXP):
+            if target is not None and self.heroes is not None:
+                return self.heroes.add_hero_exp(guid, int(target), amount) > 0
+            if self.level is not None:
+                self.level.add_exp(guid, amount)
+                return True
+            return False
         target_prop = {
             int(ItemSubType.HP): ("HP", "MAXHP"),
             int(ItemSubType.MP): ("MP", "MAXMP"),
@@ -200,7 +231,7 @@ class ItemModule(Module):
         k.set_property(guid, prop_name, min(cap, cur + amount) if cap else cur + amount)
         return True
 
-    def _consume_token(self, guid: Guid, config_id: str) -> bool:
+    def _consume_token(self, guid: Guid, config_id: str, target) -> bool:
         """TOKEN family: currency grants (Gold/Money)."""
         e = self._item_config(config_id)
         sub = int(e.values.get("ItemSubType", -1))
@@ -210,6 +241,54 @@ class ItemModule(Module):
         k.set_property(guid, prop_name,
                        int(k.get_property(guid, prop_name)) + amount)
         return True
+
+    def _consume_equip(self, guid: Guid, config_id: str, target) -> bool:
+        """EQUIP family (NFCItemEquipConsumeProcessModule is an empty
+        skeleton; the shop's default branch shows the intent): using an
+        equip token materializes the equip as a unique BagEquipList row."""
+        return self.pack.create_equip(guid, config_id) is not None
+
+    def _consume_gem(self, guid: Guid, config_id: str, target) -> bool:
+        """GEM family (NFCItemGemConsumeProcessModule is an empty
+        skeleton): socket the gem into a TARGET equip row — its stat
+        columns fold into the owner's stats while that equip is worn
+        (EquipModule.refresh reads the sockets).  Sockets live in the
+        row's InlayInfo column, so a recycled row or a relog can never
+        inherit or lose them."""
+        if target is None:
+            return False
+        equips = self.pack.equips(guid)
+        if int(target) not in equips:
+            return False
+        k = self.kernel
+        row = int(target)
+        gems = self.gems_of(guid, row)
+        if len(gems) >= self.max_sockets:
+            return False
+        gems.append(config_id)
+        k.state = k.store.record_set(k.state, guid, BAG_EQUIP, row,
+                                     "InlayInfo", ";".join(gems))
+        k.state = k.store.record_set(k.state, guid, BAG_EQUIP, row,
+                                     "SlotCount", len(gems))
+        if self.equip is not None:
+            self.equip.refresh(guid)
+        return True
+
+    def gems_of(self, guid: Guid, equip_row: int) -> List[str]:
+        """Socketed gem ids, straight from the row's InlayInfo column."""
+        k = self.kernel
+        raw = str(k.store.record_get(k.state, guid, BAG_EQUIP,
+                                     int(equip_row), "InlayInfo"))
+        return [g for g in raw.split(";") if g]
+
+    def _consume_card(self, guid: Guid, config_id: str, target) -> bool:
+        """CARD family (NFCItemCardConsumeProcessModule): the card IS the
+        hero config — add it to the collection (AddHero(self, strItemID));
+        a duplicate stacks a star (HeroModule.add_hero)."""
+        if self.heroes is None:
+            return False
+        return self.heroes.add_hero(guid, config_id) is not None
+
 
 
 class EquipModule(Module):
@@ -223,6 +302,7 @@ class EquipModule(Module):
         super().__init__()
         self.pack = pack
         self.properties = properties  # game.stats.PropertyModule
+        self.items = None  # ItemModule; supplies gem sockets per equip
         pack.on_equip_deleted.append(lambda owner, _row: self.refresh(owner))
 
     def wear(self, guid: Guid, row: int) -> bool:
@@ -258,18 +338,25 @@ class EquipModule(Module):
         return out
 
     def refresh(self, guid: Guid) -> None:
-        """Re-sum worn equips' element-config stat columns into the EQUIP
-        group row (call after restore too); the per-tick recompute folds
-        groups into final stats."""
+        """Re-sum worn equips' element-config stat columns — plus their
+        socketed gems' — into the EQUIP group row (call after restore
+        too); the per-tick recompute folds groups into final stats."""
         elems = self.kernel.elements
         totals = {n: 0 for n in STAT_NAMES}
-        for config_id in self.worn(guid).values():
+
+        def fold(config_id: str) -> None:
             if not elems.exists(config_id):
-                continue
+                return
             vals = elems.element(config_id).values
             for n in STAT_NAMES:
                 v = vals.get(n)
                 if v:
                     totals[n] += int(v)
+
+        for row, config_id in self.worn(guid).items():
+            fold(config_id)
+            if self.items is not None:
+                for gem_id in self.items.gems_of(guid, row):
+                    fold(gem_id)
         for n, v in totals.items():
             self.properties.set_group_value(guid, n, PropertyGroup.EQUIP, v)

@@ -106,18 +106,53 @@ def standard_registry(extra: Optional[Iterable[ClassDef]] = None) -> ClassRegist
             records=[
                 _comm_property_record(),
                 _buff_record(),
+                # full reference column set (Class/Player.xml:70-93) with
+                # one deviation: heroes and their worn equips are
+                # row-identified (EquipN holds a BagEquipList row+1, 0 =
+                # empty) — the reference's per-row GUID columns exist only
+                # to find rows again
                 record(
                     "PlayerHero",
-                    16,
+                    64,
                     [
                         ("GUID", "object"),
                         ("ConfigID", "string"),
                         ("Level", "int"),
                         ("Exp", "int"),
                         ("Star", "int"),
+                        ("Equip1", "int"),
+                        ("Equip2", "int"),
+                        ("Equip3", "int"),
+                        ("Equip4", "int"),
+                        ("Equip5", "int"),
+                        ("Equip6", "int"),
+                        ("Talent1", "string"),
+                        ("Talent2", "string"),
+                        ("Talent3", "string"),
+                        ("Talent4", "string"),
+                        ("Talent5", "string"),
+                        ("Skill1", "string"),
+                        ("Skill2", "string"),
+                        ("Skill3", "string"),
+                        ("Skill4", "string"),
+                        ("Skill5", "string"),
+                        ("FightSkill", "string"),
                     ],
                     private=True,
                     save=True,
+                ),
+                # battle line-up: hero record row per fight position
+                # (Class/Player.xml:94-97 PlayerFightHero, Row=5)
+                record(
+                    "PlayerFightHero",
+                    5,
+                    [
+                        ("HeroRow", "int"),  # PlayerHero row + 1; 0 = empty
+                        ("FightPos", "int"),
+                    ],
+                    private=True,
+                    save=True,
+                    upload=True,
                 ),
                 record(
                     "BagItemList",
@@ -142,6 +177,10 @@ def standard_registry(extra: Optional[Iterable[ClassDef]] = None) -> ClassRegist
                         ("ExpiredType", "int"),
                         ("Date", "int"),
                         ("SlotCount", "int"),
+                        # socketed gem config ids, ";"-joined — row state
+                        # lives IN the record so recycle/relog are safe
+                        # (reference InlayInfo column)
+                        ("InlayInfo", "string"),
                     ],
                     private=True,
                     save=True,
@@ -266,8 +305,45 @@ def standard_registry(extra: Optional[Iterable[ClassDef]] = None) -> ClassRegist
                 prop("Extend", "string"),
                 prop("Icon", "string"),
                 prop("HeroTye", "int"),
+                # hero-card columns: initial skill/talent loadout copied
+                # into the PlayerHero row on add_hero (Hero.xlsx shape)
+                prop("Skill1", "string"),
+                prop("Skill2", "string"),
+                prop("Skill3", "string"),
+                prop("Skill4", "string"),
+                prop("Skill5", "string"),
+                prop("Talent1", "string"),
+                prop("Talent2", "string"),
+                prop("Talent3", "string"),
+                prop("Talent4", "string"),
+                prop("Talent5", "string"),
             ]
             + _stat_props(),
+        )
+    )
+    # skill/talent config classes: upgrade chains ride AfterUpID
+    # (reference Skill.xlsx / Talent.xlsx, consumed by
+    # HeroModule.hero_skill_up / hero_talent_up)
+    reg.define(
+        ClassDef(
+            name="Skill",
+            parent="IObject",
+            properties=[
+                prop("SkillType", "int"),
+                prop("AfterUpID", "string"),
+                prop("DamageValue", "int"),
+                prop("CoolDownTime", "float"),
+            ],
+        )
+    )
+    reg.define(
+        ClassDef(
+            name="Talent",
+            parent="IObject",
+            properties=[
+                prop("AfterUpID", "string"),
+                prop("AwardValue", "int"),
+            ],
         )
     )
     # SLG config classes (reference NFDataCfg Shop.xlsx / Building rows,
@@ -297,6 +373,8 @@ def standard_registry(extra: Optional[Iterable[ClassDef]] = None) -> ClassRegist
                 prop("Level", "int"),
                 prop("UpgradeTime", "float"),  # seconds; 0 = module default
                 prop("ProduceTime", "float"),
+                prop("ItemID", "string"),  # producible item...
+                prop("ItemList", "string"),  # ...or a ";"-joined set
             ],
         )
     )

@@ -17,10 +17,12 @@ Design differences from the reference, on purpose:
   row's lifetime, rides the wire messages (`ReqAckMoveBuildObject.row`),
   and restores from checkpoints with no registry.  The reference's
   BuildingGUID column exists only to find the row again.
-- Timers are kernel TICKS stored in the record (StateStartTime /
-  StateEndTime), so the record itself is the source of truth: resume
-  re-arms pending completions by scanning the record
-  (CheckBuildingStatusEnd), and no host timer state needs checkpointing.
+- Timers are wall-anchored absolute SECONDS stored in the record
+  (StateStartTime / StateEndTime; see _now()), so the record itself is
+  the source of truth: resume re-arms pending completions by scanning
+  the record (CheckBuildingStatusEnd), no host timer state needs
+  checkpointing, and a blob saved in one process resolves correctly in
+  a freshly-started one (downtime counts toward completion).
 - Upgrade completion has a real effect (Level column +1): the
   reference's OnUpgradeHeartBeat body is commented out ("TO ADD"), we
   complete the obvious intent.
@@ -63,6 +65,7 @@ class SLGBuildingModule(Module):
         self.produce_interval_s = produce_interval_s
         self.collect_amount = 10  # per building level, per collect interval
         self.collect_interval_s = 10.0  # accrual period for RESOURCE yield
+        self._wall_base: Optional[float] = None  # set on first _now()
         # due-tick heap over (tick, owner, kind, rec_row); the record is
         # the source of truth — entries are validated when they fire
         self._due: List[Tuple[int, Guid, str, int]] = []
@@ -82,11 +85,24 @@ class SLGBuildingModule(Module):
         self.kernel.register_class_event(on_player, "Player")
 
     # ------------------------------------------------------------ helpers
-    def _ticks(self, seconds: float) -> int:
-        return max(1, int(round(seconds / self.kernel.schedule.dt)))
+    # Time unit: WALL-ANCHORED sim seconds — wall clock at module start
+    # plus sim time (tick x dt).  Absolute seconds persist in the record
+    # (the reference stores GetNowTime() the same way,
+    # NFCSLGBuildingModule.cpp:121-124), so a player blob saved in one
+    # process resolves correctly in a freshly-started one (tick counters
+    # restart at 0; wall time doesn't), and server downtime counts toward
+    # completion (offline progression).  Fits int32 like the reference's.
+    def _dur_s(self, seconds: float) -> int:
+        """Duration in whole seconds (floor 1 — timers must fire)."""
+        return max(1, int(round(seconds)))
 
     def _now(self) -> int:
-        return int(self.kernel.tick_count)
+        if self._wall_base is None:
+            import time as _t
+
+            self._wall_base = float(_t.time())
+        return int(self._wall_base
+                   + self.kernel.tick_count * self.kernel.schedule.dt)
 
     def _get(self, guid: Guid, row: int, tag: str):
         k = self.kernel
@@ -156,7 +172,7 @@ class SLGBuildingModule(Module):
                         or 0)
             if cfg > 0:
                 secs = cfg
-        now, end = self._now(), self._now() + self._ticks(secs)
+        now, end = self._now(), self._now() + self._dur_s(secs)
         self._set(guid, row, "State", int(SLGBuildingState.UPGRADE))
         self._set(guid, row, "StateStartTime", now)
         self._set(guid, row, "StateEndTime", end)
@@ -202,7 +218,7 @@ class SLGBuildingModule(Module):
         return int(self._get(guid, row, "State"))
 
     # ------------------------------------------------------------ produce
-    def _produce_ticks(self, guid: Guid, building_row: int) -> int:
+    def _produce_dur_s(self, guid: Guid, building_row: int) -> int:
         """Per-building production interval: the Building config element's
         ProduceTime (seconds) when set, else the module default."""
         secs = self.produce_interval_s
@@ -213,14 +229,30 @@ class SLGBuildingModule(Module):
             cfg = float(elems.element(bid).values.get("ProduceTime", 0) or 0)
             if cfg > 0:
                 secs = cfg
-        return self._ticks(secs)
+        return self._dur_s(secs)
+
+    def can_produce(self, guid: Guid, building_row: int,
+                    item_id: str) -> bool:
+        """A building only produces items its CONFIG lists (ItemID or the
+        ";"-joined ItemList column) — clients pick the ids they send, so
+        an unvalidated produce would mint shop items for free."""
+        blds = self.buildings(guid)
+        bid = blds.get(building_row)
+        elems = self.kernel.elements
+        if bid is None or not elems.exists(bid):
+            return False
+        cfg = elems.element(bid).values
+        allowed = [str(cfg.get("ItemID", "") or "")]
+        allowed += str(cfg.get("ItemList", "") or "").split(";")
+        return item_id in [a for a in allowed if a]
 
     def produce(self, guid: Guid, row: int, item_id: str,
                 count: int) -> bool:
         """Queue `count` items from a building; one item lands in the bag
         per produce interval (Produce + OnProduceHeartBeat intent,
-        NFCSLGBuildingModule.cpp:275-306)."""
-        if count <= 0 or row not in self.buildings(guid):
+        NFCSLGBuildingModule.cpp:275-306).  Refuses items the building's
+        config doesn't list."""
+        if count <= 0 or not self.can_produce(guid, row, item_id):
             return False
         k = self.kernel
         rows = k.store.record_find_rows(
@@ -238,7 +270,7 @@ class SLGBuildingModule(Module):
             k.state = k.store.record_set(k.state, guid, PRODUCE_RECORD, r,
                                          "LeftCount", left + count)
             return True
-        nxt = self._now() + self._produce_ticks(guid, row)
+        nxt = self._now() + self._produce_dur_s(guid, row)
         try:
             k.state, r = k.store.record_add_row(
                 k.state, guid, PRODUCE_RECORD,
@@ -318,7 +350,7 @@ class SLGBuildingModule(Module):
                                      "LeftCount", left)
         brow = int(k.store.record_get(k.state, guid, PRODUCE_RECORD, prow,
                                       "BuildingRow"))
-        nxt = self._now() + self._produce_ticks(guid, brow)
+        nxt = self._now() + self._produce_dur_s(guid, brow)
         k.state = k.store.record_set(k.state, guid, PRODUCE_RECORD, prow,
                                      "NextTime", nxt)
         heapq.heappush(self._due, (nxt, guid, "produce", prow))
@@ -349,7 +381,7 @@ class SLGBuildingModule(Module):
         k = self.kernel
         now = self._now()
         last = int(self._get(guid, row, "LastCollect"))
-        period = self._ticks(self.collect_interval_s)
+        period = self._dur_s(self.collect_interval_s)
         intervals = (now - last) // period
         if intervals <= 0:
             return False  # nothing accrued yet
@@ -377,7 +409,7 @@ class SLGBuildingModule(Module):
                 end = max(int(self._get(guid, row, "StateEndTime")),
                           self._now() + 1)
                 heapq.heappush(self._due, (end, guid, "state", row))
-        for r in _used_rows(k, guid, PRODUCE_RECORD):
+        for r in k.store.record_used_rows(k.state, guid, PRODUCE_RECORD):
             nxt = max(
                 int(k.store.record_get(k.state, guid, PRODUCE_RECORD, r,
                                        "NextTime")),
@@ -396,14 +428,6 @@ class SLGBuildingModule(Module):
 
     def checkpoint_state(self) -> dict:
         return {}  # records are the source of truth
-
-
-def _used_rows(kernel, guid: Guid, record_name: str) -> List[int]:
-    cname, erow = kernel.store.row_of(guid)
-    rec = kernel.state.classes[cname].records.get(record_name)
-    if rec is None:
-        return []
-    return [int(r) for r in np.flatnonzero(np.asarray(rec.used[erow]))]
 
 
 class SLGShopModule(Module):

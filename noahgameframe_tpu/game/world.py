@@ -107,6 +107,11 @@ class GameWorld:
             self.items = ItemModule(self.pack)
             self.equip = EquipModule(self.pack, self.properties)
             self.heroes = HeroModule(self.properties)
+            self.heroes.scene_process = self.scene_process
+            self.items.heroes = self.heroes
+            self.items.level = self.level
+            self.items.equip = self.equip
+            self.equip.items = self.items
             self.tasks = TaskModule(self.level)
             self.buffs = BuffModule()
             self.team = TeamModule()

@@ -156,6 +156,7 @@ class MsgID(enum.IntEnum):
     REQ_PICK_ITEM = 1255
     REQ_ACCEPT_TASK = 1256
     REQ_COMPLETE_TASK = 1257
+    REQ_SET_FIGHT_HERO = 1508  # EGEC_REQ_SET_FIGHT_HERO
     ACK_ONLINE_NOTIFY = 1290
     ACK_OFFLINE_NOTIFY = 1291
 

@@ -255,6 +255,7 @@ class GameRole(ServerRole):
         s.on(MsgID.REQ_MOVE, self._on_move)
         s.on(MsgID.REQ_CHAT, self._on_chat)
         s.on(MsgID.REQ_SKILL_OBJECTX, self._on_skill)
+        s.on(MsgID.REQ_SET_FIGHT_HERO, self._on_set_fight_hero)
         s.on(MsgID.REQ_BUY_FORM_SHOP, self._on_slg_buy)
         s.on(MsgID.REQ_MOVE_BUILD_OBJECT, self._on_slg_move)
         s.on(MsgID.REQ_UP_BUILD_LVL, self._on_slg_upgrade)
@@ -444,6 +445,10 @@ class GameRole(ServerRole):
         guid = sess.guid
         targets = self._scene_players(guid)
         sess.guid = None
+        # the interest seen-state belongs to the AVATAR's view: a fresh
+        # client (crash + reconnect) starts with an empty mirror, so a
+        # stale seen-state would suppress every stationary entity forever
+        sess._interest_seen = {}
         self._guid_session.pop(guid, None)
         if guid in self.kernel.store.guid_map:
             self.kernel.destroy_object(guid)
@@ -597,6 +602,21 @@ class GameRole(ServerRole):
         if ident is None:
             return None
         return Guid(ident.svrid, ident.index)
+
+    def _on_set_fight_hero(self, conn_id: int, _msg_id: int,
+                           body: bytes) -> None:
+        """NFCHeroModule::OnSetFightHeroMsg — the hero's record row rides
+        heroid.index (heroes are row-identified)."""
+        from ..wire import ReqSetFightHero
+
+        base, req = unwrap(body, ReqSetFightHero)
+        sess = self.sessions.get(_ident_key(base.player_id))
+        if sess is None or sess.guid is None or req.heroid is None:
+            return
+        heroes = self.game_world.heroes
+        if heroes is not None:
+            heroes.set_fight_hero(sess.guid, int(req.heroid.index),
+                                  int(req.fight_pos))
 
     # ------------------------------------------------------------ SLG city
     # reference handlers: NFCSLGShopModule::OnSLGClienBuyItem and
@@ -1533,6 +1553,7 @@ class GameRole(ServerRole):
             sess = self.sessions.get(key)
             if sess is not None:
                 sess.guid = None
+                sess._interest_seen = {}
 
     def _on_npc_event(self, guid: Guid, _cname: str, ev: ObjectEvent) -> None:
         if ev == ObjectEvent.DESTROY and self.sessions:

@@ -654,6 +654,18 @@ class InterestPosSync(Message):
     ]
 
 
+class ReqSetFightHero(Message):
+    """Pick the battle line-up hero (`NFMsgShare.proto:481-486`,
+    EGEC_REQ_SET_FIGHT_HERO).  Heroes are row-identified here, so the
+    hero's PlayerHero record row rides `heroid.index` (svrid 0)."""
+
+    FIELDS = [
+        (1, "selfid", Ident, None),
+        (2, "heroid", Ident, None),
+        (3, "fight_pos", "int32", 0),
+    ]
+
+
 class RoleOnlineNotify(Message):
     """Game → World: a player came online (player guid rides the MsgBase
     envelope; `NFMsgPreGame.proto` RoleOnlineNotify)."""

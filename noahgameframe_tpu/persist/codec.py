@@ -254,6 +254,18 @@ def apply_snapshot(
     pack = ObjectDataPack.decode(blob)
     cname, _ = store.row_of(guid)
     spec = store.spec(cname)
+    # self-references (WearGUID = owner, MasterID = owner, ...) must
+    # remap to the entity's NEW guid: a relog mints a fresh guid, and the
+    # old one will never exist again
+    old_self = (Guid(pack.guid.svrid, pack.guid.index)
+                if pack.guid is not None else None)
+
+    def deref(ident: Optional[Ident]) -> Optional[Guid]:
+        if (old_self is not None and ident is not None
+                and Guid(ident.svrid, ident.index) == old_self):
+            return guid
+        return _ident_to_guid(store, ident)
+
     pl = pack.property_list or ObjectPropertyList()
     for p in pl.property_int_list:
         name = p.property_name.decode()
@@ -270,7 +282,7 @@ def apply_snapshot(
     for p in pl.property_object_list:
         name = p.property_name.decode()
         if spec.has_property(name):
-            target = _ident_to_guid(store, p.data)
+            target = deref(p.data)
             if target is not None:
                 state = store.set_property(state, guid, name, target)
             elif pending is not None and p.data is not None:
@@ -313,7 +325,7 @@ def apply_snapshot(
             for c in rowmsg.record_object_list:
                 tag = tag_of(c.col)
                 if tag is not None:
-                    target = _ident_to_guid(store, c.data)
+                    target = deref(c.data)
                     if target is not None:
                         values[tag] = target
                     elif (pending is not None and c.data is not None

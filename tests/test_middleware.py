@@ -110,11 +110,12 @@ def test_hero_collect_level_fight_stats(world, player):
     assert h.set_fight_hero(player, row)
     assert world.properties.get_group_value(
         player, "ATK_VALUE", PropertyGroup.EQUIP_AWARD) == 5  # level 1
-    # hero exp levels up to the player's cap (player level 3)
+    # progressive curve (NFIHeroModule.h): level N->N+1 costs (N+1)*100,
+    # so 1000 exp from level 1 = 200+300+400 spent -> level 4, 100 left
     lvl = h.add_hero_exp(player, row, 1000)
-    assert lvl == 3
+    assert lvl == 4
     assert world.properties.get_group_value(
-        player, "ATK_VALUE", PropertyGroup.EQUIP_AWARD) == 15
+        player, "ATK_VALUE", PropertyGroup.EQUIP_AWARD) == 20
 
 
 # ---------------------------------------------------------------- task

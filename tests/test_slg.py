@@ -18,12 +18,15 @@ from noahgameframe_tpu.game import (
 
 @pytest.fixture()
 def world():
+    # dt=1.0: building timers are whole wall-anchored seconds and one
+    # tick advances sim time by one second, so tests stay fast without
+    # sleeping (see SLGBuildingModule._now)
     w = GameWorld(WorldConfig(combat=False, movement=False, regen=False,
-                              npc_capacity=64, player_capacity=8)).start()
+                              npc_capacity=64, player_capacity=8,
+                              dt=1.0)).start()
     w.scene.create_scene(1)
-    # fast timers for the test world (records store ticks, dt = 1/30)
-    w.slg_building.upgrade_s = 4 * w.config.dt
-    w.slg_building.produce_interval_s = 3 * w.config.dt
+    w.slg_building.upgrade_s = 4
+    w.slg_building.produce_interval_s = 3
     return w
 
 
@@ -39,8 +42,9 @@ def player(world):
 
 def define_slg(world):
     e = world.kernel.elements
-    e.add_element("Building", "barracks", {"Type": 2})
-    e.add_element("Building", "temple", {"Type": 5, "UpgradeTime": 0.5})
+    e.add_element("Building", "barracks", {"Type": 2,
+                                           "ItemList": "bread;arrow"})
+    e.add_element("Building", "temple", {"Type": 5, "UpgradeTime": 15})
     e.add_element("Item", "sword_s", {"ItemType": int(ItemType.EQUIP)})
     e.add_element("Item", "bread", {"ItemType": int(ItemType.ITEM)})
     e.add_element("Shop", "shop_barracks", {
@@ -84,7 +88,7 @@ def test_upgrade_time_from_config(world, player):
     b = world.slg_building
     row = b.add_building(player, "temple", 0, 0, 0)
     assert b.upgrade(player, row)
-    # temple configures 0.5 s = 15 ticks; after 6 ticks still upgrading
+    # temple configures 15 s; after 6 ticks (= 6 s) still upgrading
     ticks(world, 6)
     assert b.building_state(player, row) == int(SLGBuildingState.UPGRADE)
     ticks(world, 12)
@@ -94,7 +98,7 @@ def test_upgrade_time_from_config(world, player):
 def test_boost_shortens_and_cancel_aborts(world, player):
     define_slg(world)
     b = world.slg_building
-    b.upgrade_s = 40 * world.config.dt
+    b.upgrade_s = 40
     # boost is only legal DURING an upgrade
     row = b.add_building(player, "barracks", 0, 0, 0)
     assert not b.boost(player, row)  # idle -> refused
@@ -122,7 +126,7 @@ def test_resource_collect_accrues_over_time(world, player):
     e = world.kernel.elements
     e.add_element("Building", "quarry", {"Type": 3})  # RESOURCE
     b = world.slg_building
-    b.collect_interval_s = 2 * world.config.dt
+    b.collect_interval_s = 2
     row = b.add_building(player, "quarry", 0, 0, 0)
     k = world.kernel
     # nothing accrued at placement — an immediate collect gets nothing
@@ -151,8 +155,8 @@ def test_produce_time_from_config(world, player):
     (the config column must not be dead)."""
     define_slg(world)
     e = world.kernel.elements
-    e.add_element("Building", "mill", {"Type": 3,
-                                       "ProduceTime": 6 * world.config.dt})
+    e.add_element("Building", "mill", {"Type": 3, "ItemID": "bread",
+                                       "ProduceTime": 6})
     b = world.slg_building  # module default is 3 ticks (fixture)
     row = b.add_building(player, "mill", 0, 0, 0)
     assert b.produce(player, row, "bread", 1)
@@ -177,7 +181,7 @@ def test_relog_rearms_upgrade_timer(world, tmp_path):
                         scene=1, group=0)
     k.set_property(g, "Level", 5)
     b = world.slg_building
-    b.upgrade_s = 5 * world.config.dt
+    b.upgrade_s = 5
     row = b.add_building(g, "barracks", 0, 0, 0)
     assert b.upgrade(g, row)
     ticks(world, 1)
@@ -220,6 +224,9 @@ def test_produce_lands_items_over_time(world, player):
     ticks(world, 4)
     assert world.pack.item_count(player, "bread") == 2
     assert b.produce_left(player, row, "bread") == 0
+    # the config gates WHAT a building can produce (client-chosen ids)
+    assert not b.produce(player, row, "sword_s", 1)
+    assert b.produce(player, row, "arrow", 1)
 
 
 def test_building_timers_survive_checkpoint(world, player, tmp_path):
@@ -227,7 +234,7 @@ def test_building_timers_survive_checkpoint(world, player, tmp_path):
     resumes and still completes (CheckBuildingStatusEnd semantics)."""
     define_slg(world)
     b = world.slg_building
-    b.upgrade_s = 10 * world.config.dt
+    b.upgrade_s = 10
     row = b.add_building(player, "barracks", 0, 0, 0)
     assert b.upgrade(player, row)
     ticks(world, 2)
@@ -235,7 +242,8 @@ def test_building_timers_survive_checkpoint(world, player, tmp_path):
     world.save(path)
 
     w2 = GameWorld(WorldConfig(combat=False, movement=False, regen=False,
-                               npc_capacity=64, player_capacity=8)).start()
+                               npc_capacity=64, player_capacity=8,
+                               dt=1.0)).start()
     w2.load(path)
     b2 = w2.slg_building
     assert b2.building_state(player, row) == int(SLGBuildingState.UPGRADE)
@@ -310,7 +318,7 @@ def test_slg_wire_handlers_end_to_end():
         RoleConfig(6, 0, "SlgGame", "127.0.0.1", 0),
         backend="py", world=world, cross_server_sync=False,
     )
-    world.slg_building.upgrade_s = 4 * world.config.dt
+    world.slg_building.upgrade_s = 4
     define_slg(world)
     sent = []
     role.server.send_raw = lambda c, m, b: (sent.append((c, m, b)), True)[1]
@@ -386,3 +394,45 @@ def test_relog_does_not_double_produce(world, tmp_path):
     ticks(world, 7)
     assert world.pack.item_count(g2, "bread") == 2  # not 4
     assert b.produce_left(g2, row, "bread") == 2
+
+
+def test_restart_into_fresh_process_resolves_timers(world, tmp_path):
+    """Building stamps are wall-anchored absolute seconds, NOT process
+    tick counts: a blob saved by a long-lived process must resolve in a
+    freshly-started one (tick counter reset to 0), with server downtime
+    counting toward completion (review finding: tick-epoch stamps left
+    buildings stuck for the old process's uptime)."""
+    from noahgameframe_tpu.persist.agent import PlayerDataAgent
+    from noahgameframe_tpu.persist.kv import MemoryKV
+
+    define_slg(world)
+    kv = MemoryKV()
+    agent = PlayerDataAgent(kv).bind(world.kernel)
+    k = world.kernel
+    b = world.slg_building
+    b._wall_base = 1_000_000.0  # process A started here
+    b.upgrade_s = 30
+    g = k.create_object("Player", {"Name": "F", "Account": "f"},
+                        scene=1, group=0)
+    row = b.add_building(g, "barracks", 0, 0, 0)
+    assert b.upgrade(g, row)
+    ticks(world, 2)
+    agent.save(g)
+
+    # fresh process: new world, tick_count back at 0, one minute later
+    w2 = GameWorld(WorldConfig(combat=False, movement=False, regen=False,
+                               npc_capacity=64, player_capacity=8,
+                               dt=1.0)).start()
+    w2.scene.create_scene(1)
+    b2 = w2.slg_building
+    b2._wall_base = 1_000_060.0  # 60 s of downtime
+    PlayerDataAgent(kv).bind(w2.kernel)
+    g2 = w2.kernel.create_object("Player", {"Name": "F", "Account": "f"},
+                                 scene=1, group=0)
+    assert b2.building_state(g2, row) == int(SLGBuildingState.UPGRADE)
+    # the 30 s upgrade elapsed during downtime: completes promptly, not
+    # after the old process's uptime worth of ticks
+    for _ in range(3):
+        w2.tick()
+    assert b2.building_state(g2, row) == int(SLGBuildingState.IDLE)
+    assert b2.building_level(g2, row) == 2
