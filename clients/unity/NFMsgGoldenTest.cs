@@ -66,6 +66,9 @@ public static class NFMsgGoldenTest
             case "ServerHeartBeat": { var m = new NFMsg.ServerHeartBeat(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "BatchPropertySync": { var m = new NFMsg.BatchPropertySync(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "InterestPosSync": { var m = new NFMsg.InterestPosSync(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqSwitchServer": { var m = new NFMsg.ReqSwitchServer(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "AckSwitchServer": { var m = new NFMsg.AckSwitchServer(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "SwitchServerData": { var m = new NFMsg.SwitchServerData(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "ReqSetFightHero": { var m = new NFMsg.ReqSetFightHero(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "RoleOnlineNotify": { var m = new NFMsg.RoleOnlineNotify(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "RoleOfflineNotify": { var m = new NFMsg.RoleOfflineNotify(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }

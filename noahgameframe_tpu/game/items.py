@@ -212,7 +212,12 @@ class ItemModule(Module):
         amount = int(e.values.get("AwardValue", 0))
         k = self.kernel
         if sub == int(ItemSubType.EXP):
-            if target is not None and self.heroes is not None:
+            if target is not None:
+                # an explicit hero target must never silently become a
+                # player grant — refuse (item stays in the bag) when the
+                # hero module is not wired
+                if self.heroes is None:
+                    return False
                 return self.heroes.add_hero_exp(guid, int(target), amount) > 0
             if self.level is not None:
                 self.level.add_exp(guid, amount)

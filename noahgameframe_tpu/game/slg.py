@@ -381,6 +381,12 @@ class SLGBuildingModule(Module):
         k = self.kernel
         now = self._now()
         last = int(self._get(guid, row, "LastCollect"))
+        if last < 1_000_000_000:
+            # stamp from a different time base (unset, or a legacy blob
+            # that stored tick counts): rebase instead of paying out an
+            # epoch's worth of intervals in one call
+            self._set(guid, row, "LastCollect", now)
+            return False
         period = self._dur_s(self.collect_interval_s)
         intervals = (now - last) // period
         if intervals <= 0:

@@ -57,6 +57,16 @@ class _MembershipModule(Module):
         super().__init__()
         self.capacity = capacity
         self.groups: Dict[Guid, GroupInfo] = {}
+        # persistence hook: (event, group, member, destroy_cleanup) with
+        # event in create/join/leave/disband/dissolve — destroy_cleanup
+        # marks a leave caused by entity destruction (logout), which must
+        # NOT drop durable membership (persist.social.SocialDataAgent)
+        self.on_membership_event = None
+        self._destroy_cleanup = False
+
+    def _fire(self, event: str, g: GroupInfo, member=None) -> None:
+        if self.on_membership_event is not None:
+            self.on_membership_event(event, g, member, self._destroy_cleanup)
 
     def after_init(self) -> None:
         from ..kernel.kernel import ObjectEvent
@@ -65,7 +75,11 @@ class _MembershipModule(Module):
             # BEFORE_DESTROY: the member's row is still live, so the
             # membership property write and count updates all succeed
             if ev == ObjectEvent.BEFORE_DESTROY and self.group_of(guid):
-                self.leave(guid)
+                self._destroy_cleanup = True
+                try:
+                    self.leave(guid)
+                finally:
+                    self._destroy_cleanup = False
 
         self.kernel.register_class_event(on_event)
 
@@ -87,6 +101,7 @@ class _MembershipModule(Module):
         self.groups[group_id] = GroupInfo(group_id, leader, [leader],
                                           self.capacity, name)
         self._set_member_prop(leader, group_id)
+        self._fire("create", self.groups[group_id], leader)
         return group_id
 
     def group_of(self, member: Guid) -> Optional[GroupInfo]:
@@ -104,6 +119,7 @@ class _MembershipModule(Module):
         g.members.append(member)
         self._set_member_prop(member, group_id)
         self.kernel.set_property(group_id, "MemberCount", len(g.members))
+        self._fire("join", g, member)
         return True
 
     def leave(self, member: Guid) -> bool:
@@ -112,8 +128,10 @@ class _MembershipModule(Module):
             return False
         g.members.remove(member)
         self._set_member_prop(member, NULL_GUID)
+        self._fire("leave", g, member)
         if not g.members:
             self._dissolve(g)
+            self._fire("dissolve", g)
             return True
         if g.leader == member:
             g.leader = g.members[0]  # leadership passes down
@@ -128,6 +146,7 @@ class _MembershipModule(Module):
         for m in list(g.members):
             self._set_member_prop(m, NULL_GUID)
         self._dissolve(g)
+        self._fire("disband", g)
         return True
 
     def _dissolve(self, g: GroupInfo) -> None:
@@ -218,6 +237,11 @@ class MailModule(Module):
         self.keep = keep
         self._boxes: Dict[str, List[Mail]] = {}
         self._next_id = 1
+        self.on_dirty = None  # fn(account) — persistence write-through
+
+    def _mark(self, account: str) -> None:
+        if self.on_dirty is not None:
+            self.on_dirty(account)
 
     def send(self, to_account: str, sender: str, title: str, body: str = "",
              gold: int = 0, items: Optional[Dict[str, int]] = None) -> int:
@@ -227,6 +251,7 @@ class MailModule(Module):
         box = self._boxes.setdefault(to_account, [])
         box.append(mail)
         del box[: max(0, len(box) - self.keep)]
+        self._mark(to_account)
         return mail.mail_id
 
     def mailbox(self, account: str) -> List[Mail]:
@@ -242,6 +267,7 @@ class MailModule(Module):
         m = self._find(account, mail_id)
         if m is not None:
             m.read = True
+            self._mark(account)
         return m
 
     def draw(self, account: str, mail_id: int, player: Guid) -> bool:
@@ -266,13 +292,17 @@ class MailModule(Module):
                            int(k.get_property(player, "Gold")) + m.gold)
         m.drawn = True
         m.read = True
+        self._mark(account)
         return True
 
     def delete(self, account: str, mail_id: int) -> bool:
         box = self._boxes.get(account, [])
         n = len(box)
         self._boxes[account] = [m for m in box if m.mail_id != mail_id]
-        return len(self._boxes[account]) != n
+        if len(self._boxes[account]) != n:
+            self._mark(account)
+            return True
+        return False
 
     # ------------------------------------------------- checkpoint/resume
     def checkpoint_state(self) -> dict:
@@ -305,12 +335,19 @@ class RankModule(Module):
     def __init__(self) -> None:
         super().__init__()
         self._lists: Dict[str, Dict[str, int]] = {}  # list -> key -> score
+        self.on_dirty = None  # fn(list_name) — persistence write-through
+
+    def _mark(self, list_name: str) -> None:
+        if self.on_dirty is not None:
+            self.on_dirty(list_name)
 
     def update(self, list_name: str, key: str, score: int) -> None:
         self._lists.setdefault(list_name, {})[key] = int(score)
+        self._mark(list_name)
 
     def remove(self, list_name: str, key: str) -> None:
-        self._lists.get(list_name, {}).pop(key, None)
+        if self._lists.get(list_name, {}).pop(key, None) is not None:
+            self._mark(list_name)
 
     def score(self, list_name: str, key: str) -> Optional[int]:
         return self._lists.get(list_name, {}).get(key)

@@ -136,6 +136,9 @@ class MsgID(enum.IntEnum):
     # per-session interest-filtered position stream (u16-quantized):
     # each client receives only entities within its interest radius
     ACK_INTEREST_POS = 8002
+    # serialized-player companion to REQ_SWITCH_SERVER (re-home without
+    # a shared database; game -> world -> target game)
+    SWITCH_SERVER_DATA = 8003
 
     # in-game actions
     REQ_MOVE = 1230
@@ -157,6 +160,9 @@ class MsgID(enum.IntEnum):
     REQ_ACCEPT_TASK = 1256
     REQ_COMPLETE_TASK = 1257
     REQ_SET_FIGHT_HERO = 1508  # EGEC_REQ_SET_FIGHT_HERO
+    # cross-game-server switch (NFDefine.proto:268-269)
+    REQ_SWITCH_SERVER = 1840  # EGMI_REQSWICHSERVER
+    ACK_SWITCH_SERVER = 1841  # EGMI_ACKSWICHSERVER
     ACK_ONLINE_NOTIFY = 1290
     ACK_OFFLINE_NOTIFY = 1291
 

@@ -64,6 +64,11 @@ class ProxyRole(ServerRole):
         # outbound pool to game servers (fed by World's game list)
         self.games = NetClientModule(backend=self.backend)
         self.clients["games"] = self.games
+        # switch re-route before the catch-all: the target game tells us
+        # its client moved; we re-point the binding, the client never
+        # sees the control message (reference: gate handles
+        # EGMI_REQSWICHSERVER from the game, NFCGSSwichServerModule)
+        self.games.on(MsgID.REQ_SWITCH_SERVER, self._on_switch_route)
         self.games.on_any(self._transpond)
 
     def _install(self) -> None:
@@ -202,6 +207,27 @@ class ProxyRole(ServerRole):
             )
 
     # ------------------------------------------------------ game → client
+    def _on_switch_route(self, _sid: int, _msg_id: int, body: bytes) -> None:
+        """Re-point a client's game binding after a cross-server switch:
+        subsequent client messages route to the new game server."""
+        from ..wire import ReqSwitchServer
+
+        _, req = unwrap(body, ReqSwitchServer)
+        if req.client_id is None:
+            return
+        conn_id = self._client_conn.get(_ident_key(req.client_id))
+        if conn_id is None:
+            return  # not our client (multi-proxy broadcast)
+        tags = self.server.conn_tags.get(conn_id)
+        if tags is not None:
+            tags["game_id"] = int(req.target_serverid)
+        # the disconnect path reads _conn_info, not conn_tags — both must
+        # re-point or a later socket death sends REQ_LEAVE_GAME to the
+        # OLD game and the new one keeps a ghost avatar forever
+        info = self._conn_info.get(conn_id)
+        if info is not None:
+            info["game_id"] = int(req.target_serverid)
+
     def _transpond(self, _sid: int, msg_id: int, body: bytes) -> None:
         """Deliver the enveloped message to each client in the envelope's
         client list (empty list → the envelope's player_id).  The whole

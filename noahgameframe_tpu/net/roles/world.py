@@ -95,7 +95,31 @@ class WorldRole(ServerRole):
         s.on(MsgID.STS_SERVER_REPORT, self._on_server_report)
         for msg in CROSS_SYNC_MSGS:
             s.on(msg, self._on_cross_sync)
+        # cross-game-server switch: targeted relays (the reference routes
+        # these through the world's cluster link, NFCGSSwichServerModule)
+        s.on(MsgID.REQ_SWITCH_SERVER, self._on_switch_relay)
+        s.on(MsgID.SWITCH_SERVER_DATA, self._on_switch_relay)
+        s.on(MsgID.ACK_SWITCH_SERVER, self._on_switch_relay)
         s.on_socket_event(self._on_socket)
+
+    def _on_switch_relay(self, conn_id: int, msg_id: int, body: bytes) -> None:
+        """Route a switch message to the ONE game it names: REQ/DATA go
+        to target_serverid, ACK returns to the originating game
+        (self_serverid)."""
+        from ..wire import AckSwitchServer, ReqSwitchServer, SwitchServerData
+
+        cls = {
+            int(MsgID.REQ_SWITCH_SERVER): ReqSwitchServer,
+            int(MsgID.SWITCH_SERVER_DATA): SwitchServerData,
+            int(MsgID.ACK_SWITCH_SERVER): AckSwitchServer,
+        }[int(msg_id)]
+        _, msg = unwrap(body, cls)
+        sid = (int(msg.self_serverid)
+               if msg_id == int(MsgID.ACK_SWITCH_SERVER)
+               else int(msg.target_serverid))
+        d = self.games.get(sid)
+        if d is not None:
+            self.server.send_raw(d.conn_id, msg_id, body)
 
     # ------------------------------------------- cross-game sync relay
     def _on_cross_sync(self, conn_id: int, msg_id: int, body: bytes) -> None:

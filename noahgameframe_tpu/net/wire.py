@@ -654,6 +654,50 @@ class InterestPosSync(Message):
     ]
 
 
+class ReqSwitchServer(Message):
+    """Cross-game-server player switch request
+    (`NFMsgShare.proto:527-536`, EGMI_REQSWICHSERVER) — game A asks game
+    B (via World) to take over a player."""
+
+    FIELDS = [
+        (1, "selfid", Ident, None),
+        (2, "self_serverid", "int64", 0),
+        (3, "target_serverid", "int64", 0),
+        (4, "gate_serverid", "int64", 0),
+        (5, "scene_id", "int64", 0),
+        (6, "client_id", Ident, None),
+        (7, "group_id", "int64", 0),
+    ]
+
+
+class AckSwitchServer(Message):
+    """Switch completed on the target (`NFMsgShare.proto:539-545`,
+    EGMI_ACKSWICHSERVER) — game A destroys its copy on receipt."""
+
+    FIELDS = [
+        (1, "selfid", Ident, None),
+        (2, "self_serverid", "int64", 0),
+        (3, "target_serverid", "int64", 0),
+        (4, "gate_serverid", "int64", 0),
+    ]
+
+
+class SwitchServerData(Message):
+    """TPU-native companion to ReqSwitchServer (msg id
+    SWITCH_SERVER_DATA): the player's serialized save-flag state
+    (persist.codec snapshot blob) plus the identity keys, so the target
+    game re-homes the player without a shared database — the reference
+    relies on both games loading the same DB row."""
+
+    FIELDS = [
+        (1, "selfid", Ident, None),
+        (2, "account", "bytes", b""),
+        (3, "name", "bytes", b""),
+        (4, "blob", "bytes", b""),
+        (5, "target_serverid", "int64", 0),
+    ]
+
+
 class ReqSetFightHero(Message):
     """Pick the battle line-up hero (`NFMsgShare.proto:481-486`,
     EGEC_REQ_SET_FIGHT_HERO).  Heroes are row-identified here, so the

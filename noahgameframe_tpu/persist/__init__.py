@@ -6,4 +6,5 @@ from .codec import ObjectDataPack, apply_snapshot, snapshot_object  # noqa: F401
 from .kv import FileKV, KVStore, MemoryKV  # noqa: F401
 from .mysql import MiniMysql, MysqlClient, MysqlError, MysqlModule  # noqa: F401
 from .resp import MiniRedisServer, RespKV  # noqa: F401
+from .social import SocialDataAgent  # noqa: F401
 from .sql import SqlModule, emit_ddl  # noqa: F401

@@ -83,3 +83,49 @@ def test_change_on_game_a_reaches_client_on_game_b(cluster2):
     a.close()
     drive(cluster2, both, lambda: akey not in b.objects, timeout=15.0)
     b.close()
+
+
+def test_switch_server_rehomes_player(cluster2):
+    """Cross-game-server switch (NFCGSSwichServerModule): the player's
+    serialized state moves from game A to game B, game A's copy is
+    destroyed, and the proxy re-routes the client's messages to B."""
+    game_a, game_b = cluster2.games[0], cluster2.games[1]
+    c = login_to_game(cluster2, "mover", "Mover", game_a.config.server_id)
+    from noahgameframe_tpu.core.datatypes import Guid
+
+    ga = Guid(c.player_guid.svrid, c.player_guid.index)
+    game_a.kernel.set_property(ga, "Level", 7)
+    game_a.kernel.set_property(ga, "Gold", 321)
+
+    assert game_a.switch_server(ga, game_b.config.server_id)
+    drive(cluster2, c, lambda: any(
+        s.account == "mover" and s.guid is not None
+        for s in game_b.sessions.values()))
+    # game A released its copy (object + session binding)
+    drive(cluster2, c, lambda: ga not in game_a.kernel.store.guid_map)
+    assert not any(s.account == "mover" and s.guid is not None
+                   for s in game_a.sessions.values())
+    # the state moved: B's copy has the saved properties under a NEW guid
+    sess_b = next(s for s in game_b.sessions.values()
+                  if s.account == "mover")
+    gb = sess_b.guid
+    assert int(game_b.kernel.get_property(gb, "Level")) == 7
+    assert int(game_b.kernel.get_property(gb, "Gold")) == 321
+    assert str(game_b.kernel.get_property(gb, "Name")) == "Mover"
+    assert int(game_b.kernel.get_property(gb, "GameID")) == \
+        game_b.config.server_id
+
+    # proxy re-routed: a client chat now lands on game B's scene
+    n0 = len(c.chat_log)
+    c.chat("hello from B")
+    drive(cluster2, c, lambda: len(c.chat_log) > n0, timeout=8.0)
+    # and the broadcast came from B's scene (B owns the avatar)
+    assert any("hello from B" in t for _, t in c.chat_log[n0:])
+
+    # post-switch disconnect: the proxy's leave notice must reach game B
+    # (the NEW owner), or B keeps a ghost avatar forever
+    c.close()
+    drive(cluster2, c, lambda: not any(
+        s.account == "mover" and s.guid is not None
+        for s in game_b.sessions.values()), timeout=8.0)
+    assert gb not in game_b.kernel.store.guid_map
