@@ -111,6 +111,13 @@ class CombatModule(Module):
                 int(GameEvent.ON_COMBAT_TABLE_OVERFLOW), self._on_overflow
             )
 
+    def execute(self) -> None:
+        # the overflow event only fires on drops — reset the per-tick
+        # reading each frame so a drop-free tick reads (0, 0) instead of
+        # the last bad tick forever (module execute runs before the
+        # kernel's device step + event dispatch in the same frame)
+        self.overflow_last = (0, 0)
+
     def _on_overflow(self, cname: str, _mask, params) -> None:
         """Host side of the tick's overflow signal: count, alert on
         budget breach, and auto-resize (double the bucket + retrace) so

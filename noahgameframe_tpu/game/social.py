@@ -503,6 +503,10 @@ class GuildModule(_MembershipModule):
     def __init__(self, capacity: int = 50) -> None:
         super().__init__(capacity)
         self._by_name: Dict[str, Guid] = {}
+        # durable name reservation (persist.social.SocialDataAgent): a
+        # guild whose members are all OFFLINE has no live entity, but its
+        # name must not be claimable by strangers
+        self.name_taken = None  # Optional[Callable[[str], bool]]
 
     @property
     def guilds(self) -> Dict[Guid, GroupInfo]:
@@ -511,6 +515,8 @@ class GuildModule(_MembershipModule):
     def create_guild(self, leader: Guid, name: str) -> Optional[Guid]:
         if not name or name in self._by_name:
             return None
+        if self.name_taken is not None and self.name_taken(name):
+            return None  # dormant durable guild owns the name
         gid = self._create_group(leader, name=name)
         if gid is not None:
             self._by_name[name] = gid

@@ -661,9 +661,14 @@ class GameRole(ServerRole):
             client_id=sess.ident,
             group_id=group,
         )
-        self.world_link.send_to_all(int(MsgID.SWITCH_SERVER_DATA),
-                                    wrap(data))
-        self.world_link.send_to_all(int(MsgID.REQ_SWITCH_SERVER), wrap(req))
+        # both messages MUST ride the same world link (suit-hash by the
+        # player) — DATA arriving after REQ on a different link would
+        # fail the switch silently
+        suit = str(guid)
+        self.world_link.send_by_suit(suit, int(MsgID.SWITCH_SERVER_DATA),
+                                     wrap(data))
+        self.world_link.send_by_suit(suit, int(MsgID.REQ_SWITCH_SERVER),
+                                     wrap(req))
         return True
 
     def _on_client_switch(self, conn_id: int, _msg_id: int,
@@ -678,13 +683,22 @@ class GameRole(ServerRole):
         self.switch_server(sess.guid, int(req.target_serverid),
                            int(req.scene_id), int(req.group_id))
 
+    SWITCH_BLOB_TTL_S = 30.0
+
     def _on_switch_data(self, _sid: int, _msg_id: int, body: bytes) -> None:
         from ..wire import SwitchServerData
 
         _, data = unwrap(body, SwitchServerData)
         if int(data.target_serverid) != self.config.server_id:
             return
-        self._switch_blobs[_ident_key(data.selfid)] = data
+        # sweep expired staged blobs (a world crash between DATA and REQ
+        # must not leak entries forever)
+        now = _time.monotonic()
+        self._switch_blobs = {
+            k: (d, t) for k, (d, t) in self._switch_blobs.items()
+            if now - t < self.SWITCH_BLOB_TTL_S
+        }
+        self._switch_blobs[_ident_key(data.selfid)] = (data, now)
 
     def _on_switch_in(self, _sid: int, _msg_id: int, body: bytes) -> None:
         """Target side (OnReqSwichServer,
@@ -696,9 +710,10 @@ class GameRole(ServerRole):
         _, req = unwrap(body, ReqSwitchServer)
         if int(req.target_serverid) != self.config.server_id:
             return
-        data = self._switch_blobs.pop(_ident_key(req.selfid), None)
-        if data is None or req.client_id is None:
+        staged = self._switch_blobs.pop(_ident_key(req.selfid), None)
+        if staged is None or req.client_id is None:
             return
+        data = staged[0]
         k = self.kernel
         guid = k.create_object(
             "Player",
@@ -726,7 +741,8 @@ class GameRole(ServerRole):
         proxy_conns = list(self.server.conn_tags)
         if len(proxy_conns) == 1:
             sess.conn_id = proxy_conns[0]
-        self._enter_scene(guid, int(req.scene_id))
+        self._enter_scene(guid, int(req.scene_id),
+                          group=int(req.group_id) or 1)
         # proxy re-route: every proxy link gets the req; the one owning
         # the client ident re-points it at this server
         for conn in proxy_conns:

@@ -104,10 +104,47 @@ def auto_bucket(
     return -(-k // align) * align
 
 
+def _radix_argsort(key: jnp.ndarray, n_bits: int) -> jnp.ndarray:
+    """Stable LSD binary-radix argsort for small non-negative int keys.
+
+    XLA's TPU `sort` is a comparison network with poor large-N
+    efficiency; per docs/ROOFLINE.md it is the prime suspect for the
+    1M-tick gap.  This replaces it with `n_bits` stable partition
+    passes, each two cumsums + one unique-index scatter over [N] i32 —
+    bandwidth-bound streaming work (~20 x 30 MB at 1M) instead of
+    O(log^2 N) comparison stages.  Bit-identical to `jnp.argsort(key)`
+    (both stable).  Opt-in via NF_RADIX=1 until chip time ranks the two
+    (virtual-CPU timing cannot)."""
+    n = key.shape[0]
+    order = jnp.arange(n, dtype=jnp.int32)
+
+    def one_pass(i, kv):
+        k, o = kv
+        bit = (k >> i) & 1
+        zeros = jnp.cumsum(1 - bit)  # inclusive; stable within each half
+        ones = jnp.cumsum(bit)
+        pos = jnp.where(bit == 0, zeros - 1, zeros[-1] + ones - 1)
+        return (
+            jnp.zeros_like(k).at[pos].set(k),
+            jnp.zeros_like(o).at[pos].set(o),
+        )
+
+    _, order = jax.lax.fori_loop(0, n_bits, one_pass, (key, order))
+    return order
+
+
+def _bits_for(n_cells: int) -> int:
+    """Bits needed for keys in [0, n_cells] (the inactive key IS
+    n_cells, so it must be representable)."""
+    return max(1, int(n_cells).bit_length())
+
+
 def _sorted_segments(pos, active, cell_size: float, width: int):
     """Shared build prefix: the ONE stable argsort by cell id plus
     per-element segment ranks.  Returns (n_cells, order, skey, seg_start,
     rank) — everything both table builders derive slots from."""
+    import os
+
     n = pos.shape[0]
     if n >= 1 << 24:
         # row ids (and other int-valued columns) ride in f32 payload
@@ -116,7 +153,10 @@ def _sorted_segments(pos, active, cell_size: float, width: int):
     n_cells = width * width
     cell = cell_of(pos, cell_size, width)
     key = jnp.where(active, cell, n_cells)
-    order = jnp.argsort(key)  # stable: preserves row order within a cell
+    if os.environ.get("NF_RADIX", "") == "1":
+        order = _radix_argsort(key, _bits_for(n_cells))
+    else:
+        order = jnp.argsort(key)  # stable: preserves row order within a cell
     skey = key[order]
     idx = jnp.arange(n, dtype=jnp.int32)
     seg_start = jnp.concatenate(

@@ -138,9 +138,41 @@ class ShardedKernel:
         k._post_tick(out, np.asarray(raw["summary"]))
         return out
 
-    def run_device(self, n: int) -> None:
-        """Fused n-tick sharded loop (benchmark path)."""
+    def _compile_headless(self):
+        """One sharded step returning ONLY the state (host outputs
+        dead-code-eliminated) — the benchmark-loop body."""
+        if getattr(self, "_jit_step1", None) is None:
+            shardings = world_shardings(self.kernel.state, self.mesh)
+
+            def step1(st):
+                st2, _out = self.kernel._trace_step(st)
+                return st2
+
+            self._jit_step1 = jax.jit(
+                step1,
+                in_shardings=(shardings,),
+                out_shardings=shardings,
+                donate_argnums=0,
+            )
+        return self._jit_step1
+
+    def run_device(self, n: int, fused: bool = True) -> None:
+        """n sharded headless ticks with zero host syncs.
+
+        fused=True (default, the documented semantics): ONE fori_loop
+        program — no per-tick dispatch, but a ~3.5x bigger XLA compile
+        (176 s vs 50 s at 512k x 8 virtual devices; round-3's 319 s
+        sharded compile was exactly this).  fused=False host-dispatches
+        a single compiled headless step per tick: state stays
+        device-resident (no readbacks), and compile cost is one step's —
+        what bench.py's ladder uses so compile doesn't dominate."""
         key = int(n)
+        if not fused:
+            step = self._compile_headless()
+            for _ in range(key):
+                self.kernel.state = step(self.kernel.state)
+            self.kernel.tick_count += key
+            return
         if self._jit_run is None or self._jit_run_n != key:
             shardings = world_shardings(self.kernel.state, self.mesh)
 

@@ -63,6 +63,10 @@ class SocialDataAgent:
             self.guilds = guilds
             self._load_guilds()
             guilds.on_membership_event = self._on_guild_event
+            # a dormant guild (all members offline, entity dissolved)
+            # still owns its name — strangers must not merge into its
+            # durable record by re-creating the name
+            guilds.name_taken = lambda n: n in self._guild_records
             from ..kernel.kernel import ObjectEvent
 
             def on_player(guid: Guid, _cn: str, ev) -> None:
@@ -176,21 +180,20 @@ class SocialDataAgent:
             if acct not in rec["members"]:
                 continue
             info = self.guilds.find_by_name(name)
-            if info is None:
-                # resurrect without re-firing durable bookkeeping
-                cb, self.guilds.on_membership_event = (
-                    self.guilds.on_membership_event, None)
-                try:
+            # resurrect/re-join without re-firing durable bookkeeping,
+            # and with the dormant-name reservation lifted for ourselves
+            cb = self.guilds.on_membership_event
+            taken = self.guilds.name_taken
+            self.guilds.on_membership_event = None
+            self.guilds.name_taken = None
+            try:
+                if info is None:
                     self.guilds.create_guild(guid, name)
-                finally:
-                    self.guilds.on_membership_event = cb
-            elif guid not in info.members:
-                cb, self.guilds.on_membership_event = (
-                    self.guilds.on_membership_event, None)
-                try:
+                elif guid not in info.members:
                     self.guilds.join(info.group_id, guid)
-                finally:
-                    self.guilds.on_membership_event = cb
+            finally:
+                self.guilds.on_membership_event = cb
+                self.guilds.name_taken = taken
             info = self.guilds.find_by_name(name)
             if info is not None and rec["leader"] == acct:
                 info.leader = guid

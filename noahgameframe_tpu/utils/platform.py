@@ -46,3 +46,20 @@ def force_cpu(n_devices: int | None = None):
     except Exception:
         pass
     return jax
+
+
+def init_compile_cache(path: str | None = None) -> str | None:
+    """Enable jax's persistent compilation cache so re-runs skip XLA
+    compile entirely (the sharded tick at 512k x 8 virtual devices costs
+    ~50 s to compile; a 4M ladder re-run should pay it once).  Path from
+    the arg, else $NF_COMPILE_CACHE, else disabled.  Returns the path in
+    effect (None = disabled)."""
+    path = path or os.environ.get("NF_COMPILE_CACHE")
+    if not path:
+        return None
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return path

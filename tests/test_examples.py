@@ -19,6 +19,7 @@ REPO = Path(__file__).resolve().parent.parent
     "tutorial4_actor.py",
     "tutorial5_sharded_world.py",
     "tutorial6_cluster.py",
+    "tutorial7_gameplay.py",
 ])
 def test_tutorial_runs(script):
     r = subprocess.run(

@@ -153,3 +153,26 @@ def test_social_kv_coexists_with_player_blobs():
     p2 = make_player(w2, "carol", "Carol")
     assert int(w2.kernel.get_property(p2, "Level")) == 12
     assert [m.title for m in w2.mail.mailbox("carol")] == ["Hello"]
+
+
+def test_dormant_guild_name_not_claimable_by_strangers():
+    """A guild whose members are all offline (entity dissolved) still
+    owns its name durably: a stranger cannot create 'Axiom' and absorb
+    the dormant record's members (review finding)."""
+    kv = MemoryKV()
+    w1 = make_world()
+    bind(w1, kv)
+    lead = make_player(w1, "lead", "Lead")
+    w1.guilds.create_guild(lead, "Axiom")
+    w1.kernel.destroy_object(lead)  # guild entity dissolves, record stays
+
+    stranger = make_player(w1, "stranger", "Stranger")
+    assert w1.guilds.create_guild(stranger, "Axiom") is None
+    assert w1.guilds.create_guild(stranger, "Other") is not None
+
+    # the rightful leader returns and gets their guild back, alone
+    lead2 = make_player(w1, "lead", "Lead")
+    info = w1.guilds.find_by_name("Axiom")
+    assert info is not None
+    assert info.leader == lead2
+    assert stranger not in info.members

@@ -356,3 +356,42 @@ def test_combat_scene_scoped_at_large_scene_ids():
         w.tick()
     assert k.get_property(a, "HP") == 50
     assert k.get_property(b, "HP") == 50
+
+
+def test_radix_argsort_matches_stable_argsort():
+    """NF_RADIX=1 swaps the cell-table's argsort for an LSD binary radix
+    sort (docs/ROOFLINE.md) — placement must be BIT-identical."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from noahgameframe_tpu.ops.stencil import _bits_for, _radix_argsort
+
+    rng = np.random.default_rng(11)
+    for n, hi in ((1, 2), (257, 9), (4096, 1024), (10_000, 156_026)):
+        key = jnp.asarray(rng.integers(0, hi, n).astype(np.int32))
+        got = np.asarray(_radix_argsort(key, _bits_for(hi - 1)))
+        want = np.asarray(jnp.argsort(key))
+        np.testing.assert_array_equal(got, want, err_msg=f"n={n} hi={hi}")
+
+
+def test_cell_table_radix_parity(monkeypatch):
+    """The whole table build under NF_RADIX=1 equals the default path."""
+    import os
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from noahgameframe_tpu.ops.stencil import build_cell_table
+
+    rng = np.random.default_rng(5)
+    n, extent, cell, width, bucket = 2000, 64.0, 4.0, 16, 16
+    pos = jnp.asarray(rng.uniform(0, extent, (n, 2)).astype(np.float32))
+    active = jnp.asarray(rng.random(n) < 0.8)
+    feats = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+
+    t0 = build_cell_table(pos, active, feats, cell, width, bucket)
+    monkeypatch.setenv("NF_RADIX", "1")
+    t1 = build_cell_table(pos, active, feats, cell, width, bucket)
+    np.testing.assert_array_equal(np.asarray(t0.slot_of), np.asarray(t1.slot_of))
+    np.testing.assert_array_equal(np.asarray(t0.payload), np.asarray(t1.payload))
+    assert int(t0.dropped) == int(t1.dropped)
