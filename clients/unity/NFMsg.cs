@@ -8072,6 +8072,1719 @@ namespace NFMsg
         }
     }
 
+    public class ItemStruct
+    {
+        public byte[] item_id = Nf.Empty;
+        public bool HasItemId = false;
+        public int item_count = 0;
+        public bool HasItemCount = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasItemId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                Nf.PutBytes(nf__o, item_id);
+            }
+            if (HasItemCount)
+            {
+                Nf.PutTag(nf__o, 2, 0);
+                Nf.PutI64(nf__o, (long)item_count);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            item_id = Nf.Empty;
+            HasItemId = false;
+            item_count = 0;
+            HasItemCount = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        item_id = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasItemId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        item_count = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasItemCount = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqAckUseItem
+    {
+        public Ident user = new Ident();
+        public bool HasUser = false;
+        public Ident item_guid = new Ident();
+        public bool HasItemGuid = false;
+        public List<EffectData> effect_data = new List<EffectData>();
+        public ItemStruct item = new ItemStruct();
+        public bool HasItem = false;
+        public Ident targetid = new Ident();
+        public bool HasTargetid = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasUser)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); user.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasItemGuid)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                var nf__sub = new MemoryStream(); item_guid.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            foreach (var nf__it in effect_data)
+            {
+                Nf.PutTag(nf__o, 3, 2);
+                var nf__sub = new MemoryStream(); nf__it.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasItem)
+            {
+                Nf.PutTag(nf__o, 4, 2);
+                var nf__sub = new MemoryStream(); item.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasTargetid)
+            {
+                Nf.PutTag(nf__o, 5, 2);
+                var nf__sub = new MemoryStream(); targetid.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            user = new Ident();
+            HasUser = false;
+            item_guid = new Ident();
+            HasItemGuid = false;
+            effect_data.Clear();
+            item = new ItemStruct();
+            HasItem = false;
+            targetid = new Ident();
+            HasTargetid = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        user = nf__m; HasUser = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        item_guid = nf__m; HasItemGuid = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new EffectData();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        effect_data.Add(nf__m);
+                        break;
+                    }
+                    case 4:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new ItemStruct();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        item = nf__m; HasItem = true;
+                        break;
+                    }
+                    case 5:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        targetid = nf__m; HasTargetid = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqWearEquip
+    {
+        public Ident selfid = new Ident();
+        public bool HasSelfid = false;
+        public Ident equipid = new Ident();
+        public bool HasEquipid = false;
+        public Ident target_id = new Ident();
+        public bool HasTargetId = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasSelfid)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); selfid.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasEquipid)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                var nf__sub = new MemoryStream(); equipid.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasTargetId)
+            {
+                Nf.PutTag(nf__o, 3, 2);
+                var nf__sub = new MemoryStream(); target_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            selfid = new Ident();
+            HasSelfid = false;
+            equipid = new Ident();
+            HasEquipid = false;
+            target_id = new Ident();
+            HasTargetId = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        selfid = nf__m; HasSelfid = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        equipid = nf__m; HasEquipid = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        target_id = nf__m; HasTargetId = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class TakeOffEquip
+    {
+        public Ident selfid = new Ident();
+        public bool HasSelfid = false;
+        public Ident equipid = new Ident();
+        public bool HasEquipid = false;
+        public Ident target_id = new Ident();
+        public bool HasTargetId = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasSelfid)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); selfid.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasEquipid)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                var nf__sub = new MemoryStream(); equipid.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasTargetId)
+            {
+                Nf.PutTag(nf__o, 3, 2);
+                var nf__sub = new MemoryStream(); target_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            selfid = new Ident();
+            HasSelfid = false;
+            equipid = new Ident();
+            HasEquipid = false;
+            target_id = new Ident();
+            HasTargetId = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        selfid = nf__m; HasSelfid = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        equipid = nf__m; HasEquipid = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        target_id = nf__m; HasTargetId = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqAcceptTask
+    {
+        public byte[] task_id = Nf.Empty;
+        public bool HasTaskId = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasTaskId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                Nf.PutBytes(nf__o, task_id);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            task_id = Nf.Empty;
+            HasTaskId = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        task_id = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasTaskId = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqCompeleteTask
+    {
+        public byte[] task_id = Nf.Empty;
+        public bool HasTaskId = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasTaskId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                Nf.PutBytes(nf__o, task_id);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            task_id = Nf.Empty;
+            HasTaskId = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        task_id = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasTaskId = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class TeammemberInfo
+    {
+        public Ident player_id = new Ident();
+        public bool HasPlayerId = false;
+        public byte[] name = Nf.Empty;
+        public bool HasName = false;
+        public int nLevel = 0;
+        public bool HasNLevel = false;
+        public int job = 0;
+        public bool HasJob = false;
+        public byte[] HeadIcon = Nf.Empty;
+        public bool HasHeadIcon = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasPlayerId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); player_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasName)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                Nf.PutBytes(nf__o, name);
+            }
+            if (HasNLevel)
+            {
+                Nf.PutTag(nf__o, 3, 0);
+                Nf.PutI64(nf__o, (long)nLevel);
+            }
+            if (HasJob)
+            {
+                Nf.PutTag(nf__o, 4, 0);
+                Nf.PutI64(nf__o, (long)job);
+            }
+            if (HasHeadIcon)
+            {
+                Nf.PutTag(nf__o, 5, 2);
+                Nf.PutBytes(nf__o, HeadIcon);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            player_id = new Ident();
+            HasPlayerId = false;
+            name = Nf.Empty;
+            HasName = false;
+            nLevel = 0;
+            HasNLevel = false;
+            job = 0;
+            HasJob = false;
+            HeadIcon = Nf.Empty;
+            HasHeadIcon = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        player_id = nf__m; HasPlayerId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        name = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasName = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        nLevel = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasNLevel = true;
+                        break;
+                    }
+                    case 4:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        job = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasJob = true;
+                        break;
+                    }
+                    case 5:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        HeadIcon = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasHeadIcon = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class TeamInfo
+    {
+        public Ident team_id = new Ident();
+        public bool HasTeamId = false;
+        public Ident captain_id = new Ident();
+        public bool HasCaptainId = false;
+        public List<TeammemberInfo> teammemberInfo = new List<TeammemberInfo>();
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasTeamId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); team_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasCaptainId)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                var nf__sub = new MemoryStream(); captain_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            foreach (var nf__it in teammemberInfo)
+            {
+                Nf.PutTag(nf__o, 3, 2);
+                var nf__sub = new MemoryStream(); nf__it.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            team_id = new Ident();
+            HasTeamId = false;
+            captain_id = new Ident();
+            HasCaptainId = false;
+            teammemberInfo.Clear();
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        team_id = nf__m; HasTeamId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        captain_id = nf__m; HasCaptainId = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new TeammemberInfo();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        teammemberInfo.Add(nf__m);
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqAckCreateTeam
+    {
+        public Ident team_id = new Ident();
+        public bool HasTeamId = false;
+        public TeamInfo xTeamInfo = new TeamInfo();
+        public bool HasXTeamInfo = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasTeamId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); team_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasXTeamInfo)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                var nf__sub = new MemoryStream(); xTeamInfo.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            team_id = new Ident();
+            HasTeamId = false;
+            xTeamInfo = new TeamInfo();
+            HasXTeamInfo = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        team_id = nf__m; HasTeamId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new TeamInfo();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        xTeamInfo = nf__m; HasXTeamInfo = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqAckJoinTeam
+    {
+        public Ident team_id = new Ident();
+        public bool HasTeamId = false;
+        public TeamInfo xTeamInfo = new TeamInfo();
+        public bool HasXTeamInfo = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasTeamId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); team_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasXTeamInfo)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                var nf__sub = new MemoryStream(); xTeamInfo.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            team_id = new Ident();
+            HasTeamId = false;
+            xTeamInfo = new TeamInfo();
+            HasXTeamInfo = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        team_id = nf__m; HasTeamId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new TeamInfo();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        xTeamInfo = nf__m; HasXTeamInfo = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqAckLeaveTeam
+    {
+        public Ident team_id = new Ident();
+        public bool HasTeamId = false;
+        public TeamInfo xTeamInfo = new TeamInfo();
+        public bool HasXTeamInfo = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasTeamId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); team_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasXTeamInfo)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                var nf__sub = new MemoryStream(); xTeamInfo.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            team_id = new Ident();
+            HasTeamId = false;
+            xTeamInfo = new TeamInfo();
+            HasXTeamInfo = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        team_id = nf__m; HasTeamId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new TeamInfo();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        xTeamInfo = nf__m; HasXTeamInfo = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqAckOprTeamMember
+    {
+        public Ident team_id = new Ident();
+        public bool HasTeamId = false;
+        public Ident member_id = new Ident();
+        public bool HasMemberId = false;
+        public int type = 0;
+        public bool HasType = false;
+        public TeamInfo xTeamInfo = new TeamInfo();
+        public bool HasXTeamInfo = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasTeamId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); team_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasMemberId)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                var nf__sub = new MemoryStream(); member_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasType)
+            {
+                Nf.PutTag(nf__o, 3, 0);
+                Nf.PutI64(nf__o, (long)type);
+            }
+            if (HasXTeamInfo)
+            {
+                Nf.PutTag(nf__o, 4, 2);
+                var nf__sub = new MemoryStream(); xTeamInfo.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            team_id = new Ident();
+            HasTeamId = false;
+            member_id = new Ident();
+            HasMemberId = false;
+            type = 0;
+            HasType = false;
+            xTeamInfo = new TeamInfo();
+            HasXTeamInfo = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        team_id = nf__m; HasTeamId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        member_id = nf__m; HasMemberId = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        type = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasType = true;
+                        break;
+                    }
+                    case 4:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new TeamInfo();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        xTeamInfo = nf__m; HasXTeamInfo = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqAckCreateGuild
+    {
+        public Ident guild_id = new Ident();
+        public bool HasGuildId = false;
+        public byte[] guild_name = Nf.Empty;
+        public bool HasGuildName = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasGuildId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); guild_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasGuildName)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                Nf.PutBytes(nf__o, guild_name);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            guild_id = new Ident();
+            HasGuildId = false;
+            guild_name = Nf.Empty;
+            HasGuildName = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        guild_id = nf__m; HasGuildId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        guild_name = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasGuildName = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqAckJoinGuild
+    {
+        public Ident guild_id = new Ident();
+        public bool HasGuildId = false;
+        public byte[] guild_name = Nf.Empty;
+        public bool HasGuildName = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasGuildId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); guild_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasGuildName)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                Nf.PutBytes(nf__o, guild_name);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            guild_id = new Ident();
+            HasGuildId = false;
+            guild_name = Nf.Empty;
+            HasGuildName = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        guild_id = nf__m; HasGuildId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        guild_name = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasGuildName = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqAckLeaveGuild
+    {
+        public Ident guild_id = new Ident();
+        public bool HasGuildId = false;
+        public byte[] guild_name = Nf.Empty;
+        public bool HasGuildName = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasGuildId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); guild_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasGuildName)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                Nf.PutBytes(nf__o, guild_name);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            guild_id = new Ident();
+            HasGuildId = false;
+            guild_name = Nf.Empty;
+            HasGuildName = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        guild_id = nf__m; HasGuildId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        guild_name = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasGuildName = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqSearchGuild
+    {
+        public byte[] guild_name = Nf.Empty;
+        public bool HasGuildName = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasGuildName)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                Nf.PutBytes(nf__o, guild_name);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            guild_name = Nf.Empty;
+            HasGuildName = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        guild_name = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasGuildName = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class SearchGuildObject
+    {
+        public Ident guild_ID = new Ident();
+        public bool HasGuildID = false;
+        public byte[] guild_name = Nf.Empty;
+        public bool HasGuildName = false;
+        public byte[] guild_icon = Nf.Empty;
+        public bool HasGuildIcon = false;
+        public int guild_member_count = 0;
+        public bool HasGuildMemberCount = false;
+        public int guild_member_max_count = 0;
+        public bool HasGuildMemberMaxCount = false;
+        public int guild_honor = 0;
+        public bool HasGuildHonor = false;
+        public int guild_rank = 0;
+        public bool HasGuildRank = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasGuildID)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); guild_ID.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasGuildName)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                Nf.PutBytes(nf__o, guild_name);
+            }
+            if (HasGuildIcon)
+            {
+                Nf.PutTag(nf__o, 3, 2);
+                Nf.PutBytes(nf__o, guild_icon);
+            }
+            if (HasGuildMemberCount)
+            {
+                Nf.PutTag(nf__o, 4, 0);
+                Nf.PutI64(nf__o, (long)guild_member_count);
+            }
+            if (HasGuildMemberMaxCount)
+            {
+                Nf.PutTag(nf__o, 5, 0);
+                Nf.PutI64(nf__o, (long)guild_member_max_count);
+            }
+            if (HasGuildHonor)
+            {
+                Nf.PutTag(nf__o, 6, 0);
+                Nf.PutI64(nf__o, (long)guild_honor);
+            }
+            if (HasGuildRank)
+            {
+                Nf.PutTag(nf__o, 7, 0);
+                Nf.PutI64(nf__o, (long)guild_rank);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            guild_ID = new Ident();
+            HasGuildID = false;
+            guild_name = Nf.Empty;
+            HasGuildName = false;
+            guild_icon = Nf.Empty;
+            HasGuildIcon = false;
+            guild_member_count = 0;
+            HasGuildMemberCount = false;
+            guild_member_max_count = 0;
+            HasGuildMemberMaxCount = false;
+            guild_honor = 0;
+            HasGuildHonor = false;
+            guild_rank = 0;
+            HasGuildRank = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        guild_ID = nf__m; HasGuildID = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        guild_name = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasGuildName = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        guild_icon = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasGuildIcon = true;
+                        break;
+                    }
+                    case 4:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        guild_member_count = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasGuildMemberCount = true;
+                        break;
+                    }
+                    case 5:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        guild_member_max_count = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasGuildMemberMaxCount = true;
+                        break;
+                    }
+                    case 6:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        guild_honor = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasGuildHonor = true;
+                        break;
+                    }
+                    case 7:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        guild_rank = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasGuildRank = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class AckSearchGuild
+    {
+        public List<SearchGuildObject> guild_list = new List<SearchGuildObject>();
+        public void Encode(MemoryStream nf__o)
+        {
+            foreach (var nf__it in guild_list)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); nf__it.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            guild_list.Clear();
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new SearchGuildObject();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        guild_list.Add(nf__m);
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
     public class PackMysqlParam
     {
         public byte[] strRecordName = Nf.Empty;

@@ -82,6 +82,24 @@ public static class NFMsgGoldenTest
             case "EffectData": { var m = new NFMsg.EffectData(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "ReqAckUseSkill": { var m = new NFMsg.ReqAckUseSkill(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "ReqAckSwapScene": { var m = new NFMsg.ReqAckSwapScene(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ItemStruct": { var m = new NFMsg.ItemStruct(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqAckUseItem": { var m = new NFMsg.ReqAckUseItem(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqWearEquip": { var m = new NFMsg.ReqWearEquip(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "TakeOffEquip": { var m = new NFMsg.TakeOffEquip(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqAcceptTask": { var m = new NFMsg.ReqAcceptTask(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqCompeleteTask": { var m = new NFMsg.ReqCompeleteTask(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "TeammemberInfo": { var m = new NFMsg.TeammemberInfo(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "TeamInfo": { var m = new NFMsg.TeamInfo(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqAckCreateTeam": { var m = new NFMsg.ReqAckCreateTeam(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqAckJoinTeam": { var m = new NFMsg.ReqAckJoinTeam(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqAckLeaveTeam": { var m = new NFMsg.ReqAckLeaveTeam(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqAckOprTeamMember": { var m = new NFMsg.ReqAckOprTeamMember(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqAckCreateGuild": { var m = new NFMsg.ReqAckCreateGuild(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqAckJoinGuild": { var m = new NFMsg.ReqAckJoinGuild(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqAckLeaveGuild": { var m = new NFMsg.ReqAckLeaveGuild(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqSearchGuild": { var m = new NFMsg.ReqSearchGuild(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "SearchGuildObject": { var m = new NFMsg.SearchGuildObject(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "AckSearchGuild": { var m = new NFMsg.AckSearchGuild(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "PackMysqlParam": { var m = new NFMsg.PackMysqlParam(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "PackMysqlServerInfo": { var m = new NFMsg.PackMysqlServerInfo(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "PackSURLParam": { var m = new NFMsg.PackSURLParam(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }

@@ -45,16 +45,31 @@ from ..net.wire import (
     ObjectRecordSwap,
     ObjectRecordVector3,
     Position,
+    ReqAcceptTask,
     ReqAccountLogin,
+    ReqAckCreateGuild,
+    ReqAckCreateTeam,
+    ReqAckJoinGuild,
+    ReqAckJoinTeam,
+    ReqAckLeaveGuild,
+    ReqAckLeaveTeam,
+    ReqAckOprTeamMember,
     ReqAckPlayerChat,
     ReqAckPlayerMove,
+    ReqAckUseItem,
     ReqAckUseSkill,
+    ReqCompeleteTask,
     ReqConnectWorld,
     ReqCreateRole,
     ReqEnterGameServer,
     ReqRoleList,
+    ReqSearchGuild,
     ReqSelectServer,
+    ReqWearEquip,
+    AckSearchGuild,
+    ItemStruct,
     RoleLiteInfo,
+    TakeOffEquip,
     ident_key as _key,
     unwrap,
     wrap,
@@ -102,6 +117,10 @@ class GameClient:
         self.chat_log: List[Tuple[str, str]] = []
         self.moves: List[ReqAckPlayerMove] = []
         self.skills: List[ReqAckUseSkill] = []
+        self.item_acks: list = []
+        self.team_acks: list = []
+        self.guild_acks: list = []
+        self.guild_search: list = []
         self._handlers: Dict[int, Callable[[MsgBase], None]] = {}
         self._install()
 
@@ -138,6 +157,25 @@ class GameClient:
         h[int(MsgID.ACK_MOVE)] = self._on_move
         h[int(MsgID.ACK_CHAT)] = self._on_chat
         h[int(MsgID.ACK_SKILL_OBJECTX)] = self._on_skill
+        # middleware acks: stored raw-decoded for callers to inspect
+        def keep(store: list, cls):
+            def on(base: MsgBase) -> None:
+                store.append(cls.decode(base.msg_data))
+            return on
+
+        h[int(MsgID.ACK_ITEM_OBJECT)] = keep(self.item_acks, ReqAckUseItem)
+        h[int(MsgID.ACK_CREATE_TEAM)] = keep(self.team_acks, ReqAckCreateTeam)
+        h[int(MsgID.ACK_JOIN_TEAM)] = keep(self.team_acks, ReqAckJoinTeam)
+        h[int(MsgID.ACK_LEAVE_TEAM)] = keep(self.team_acks, ReqAckLeaveTeam)
+        h[int(MsgID.ACK_OPRMEMBER_TEAM)] = keep(self.team_acks,
+                                                ReqAckOprTeamMember)
+        h[int(MsgID.ACK_CREATE_GUILD)] = keep(self.guild_acks,
+                                              ReqAckCreateGuild)
+        h[int(MsgID.ACK_JOIN_GUILD)] = keep(self.guild_acks, ReqAckJoinGuild)
+        h[int(MsgID.ACK_LEAVE_GUILD)] = keep(self.guild_acks,
+                                             ReqAckLeaveGuild)
+        h[int(MsgID.ACK_SEARCH_GUILD)] = keep(self.guild_search,
+                                              AckSearchGuild)
 
     def connect(self, host: str, port: int) -> None:
         """Dial an endpoint (login first, later the granted proxy)."""
@@ -506,6 +544,57 @@ class GameClient:
 
     def _on_move(self, base: MsgBase) -> None:
         self.moves.append(ReqAckPlayerMove.decode(base.msg_data))
+
+    def use_item(self, config_id: str, target_row: int | None = None) -> None:
+        """EGMI_REQ_ITEM_OBJECT — family targets (hero/equip row) ride
+        targetid.index with svrid == 1 (the game role's ROW_TARGET_SVRID
+        tag: row 0 is a valid record row, so a zeroed ident must keep
+        meaning "no target")."""
+        self._send(MsgID.REQ_ITEM_OBJECT, ReqAckUseItem(
+            item=ItemStruct(item_id=config_id.encode(), item_count=1),
+            targetid=(Ident(svrid=1, index=target_row)
+                      if target_row is not None else None),
+        ))
+
+    def wear_equip(self, row: int) -> None:
+        self._send(MsgID.WEAR_EQUIP,
+                   ReqWearEquip(equipid=Ident(svrid=0, index=row)))
+
+    def take_off_equip(self, row: int) -> None:
+        self._send(MsgID.TAKEOFF_EQUIP,
+                   TakeOffEquip(equipid=Ident(svrid=0, index=row)))
+
+    def accept_task(self, task_id: str) -> None:
+        self._send(MsgID.REQ_ACCEPT_TASK,
+                   ReqAcceptTask(task_id=task_id.encode()))
+
+    def complete_task(self, task_id: str) -> None:
+        self._send(MsgID.REQ_COMPLETE_TASK,
+                   ReqCompeleteTask(task_id=task_id.encode()))
+
+    def create_team(self) -> None:
+        self._send(MsgID.REQ_CREATE_TEAM, ReqAckCreateTeam())
+
+    def join_team(self, team_id: "Ident") -> None:
+        self._send(MsgID.REQ_JOIN_TEAM, ReqAckJoinTeam(team_id=team_id))
+
+    def leave_team(self) -> None:
+        self._send(MsgID.REQ_LEAVE_TEAM, ReqAckLeaveTeam())
+
+    def create_guild(self, name: str) -> None:
+        self._send(MsgID.REQ_CREATE_GUILD,
+                   ReqAckCreateGuild(guild_name=name.encode()))
+
+    def join_guild(self, name: str) -> None:
+        self._send(MsgID.REQ_JOIN_GUILD,
+                   ReqAckJoinGuild(guild_name=name.encode()))
+
+    def leave_guild(self) -> None:
+        self._send(MsgID.REQ_LEAVE_GUILD, ReqAckLeaveGuild())
+
+    def search_guild(self, name: str = "") -> None:
+        self._send(MsgID.REQ_SEARCH_GUILD,
+                   ReqSearchGuild(guild_name=name.encode()))
 
     def chat(self, text: str) -> None:
         self._send(

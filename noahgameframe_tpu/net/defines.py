@@ -159,10 +159,30 @@ class MsgID(enum.IntEnum):
     REQ_PICK_ITEM = 1255
     REQ_ACCEPT_TASK = 1256
     REQ_COMPLETE_TASK = 1257
+    # guild ops (NFDefine.proto:184-193)
+    REQ_CREATE_GUILD = 1300
+    ACK_CREATE_GUILD = 1301
+    REQ_JOIN_GUILD = 1302
+    ACK_JOIN_GUILD = 1303
+    REQ_LEAVE_GUILD = 1304
+    ACK_LEAVE_GUILD = 1305
+    REQ_SEARCH_GUILD = 1308
+    ACK_SEARCH_GUILD = 1309
     REQ_SET_FIGHT_HERO = 1508  # EGEC_REQ_SET_FIGHT_HERO
+    WEAR_EQUIP = 1509  # EGEC_WEAR_EQUIP
+    TAKEOFF_EQUIP = 1510  # EGEC_TAKEOFF_EQUIP
     # cross-game-server switch (NFDefine.proto:268-269)
     REQ_SWITCH_SERVER = 1840  # EGMI_REQSWICHSERVER
     ACK_SWITCH_SERVER = 1841  # EGMI_ACKSWICHSERVER
+    # teams (NFDefine.proto:271-278)
+    REQ_CREATE_TEAM = 1860
+    ACK_CREATE_TEAM = 1861
+    REQ_JOIN_TEAM = 1862
+    ACK_JOIN_TEAM = 1863
+    REQ_LEAVE_TEAM = 1864
+    ACK_LEAVE_TEAM = 1865
+    REQ_OPRMEMBER_TEAM = 1867
+    ACK_OPRMEMBER_TEAM = 1868
     ACK_ONLINE_NOTIFY = 1290
     ACK_OFFLINE_NOTIFY = 1291
 

@@ -79,15 +79,36 @@ from ..wire import (
     RecordObject,
     RecordString,
     RecordVector3,
+    ReqAcceptTask,
+    ReqAckCreateGuild,
+    ReqAckCreateTeam,
+    ReqAckJoinGuild,
+    ReqAckJoinTeam,
+    ReqAckLeaveGuild,
+    ReqAckLeaveTeam,
+    ReqAckOprTeamMember,
     ReqAckPlayerChat,
     ReqAckPlayerMove,
     ReqAckSwapScene,
+    ReqAckUseItem,
     ReqAckUseSkill,
+    ReqCompeleteTask,
     ReqCreateRole,
     ReqDeleteRole,
     ReqEnterGameServer,
     ReqRoleList,
+    ReqSearchGuild,
+    ReqSetFightHero,
+    ReqSwitchServer,
+    ReqWearEquip,
+    AckSearchGuild,
+    AckSwitchServer,
     RoleLiteInfo,
+    SearchGuildObject,
+    SwitchServerData,
+    TakeOffEquip,
+    TeamInfo,
+    TeammemberInfo,
     Vector2,
     Vector3,
     ident_key as _ident_key,
@@ -97,6 +118,12 @@ from ..wire import (
 from .base import RoleConfig, ServerRole
 
 _IdentKey = Tuple[int, int]
+
+# row-identified wire targets (hero/equip record rows) ride Ident.index
+# with THIS svrid tag — row 0 is valid, and protoc clients always send
+# the required field (zeroed when untargeted), so a plain falsy test on
+# the index cannot discriminate "no target" from "row 0"
+ROW_TARGET_SVRID = 1
 
 
 def guid_ident(g: Guid) -> Ident:
@@ -263,6 +290,19 @@ class GameRole(ServerRole):
         s.on(MsgID.REQ_SKILL_OBJECTX, self._on_skill)
         s.on(MsgID.REQ_SET_FIGHT_HERO, self._on_set_fight_hero)
         s.on(MsgID.REQ_SWITCH_SERVER, self._on_client_switch)
+        s.on(MsgID.REQ_ITEM_OBJECT, self._on_use_item)
+        s.on(MsgID.WEAR_EQUIP, self._on_wear_equip)
+        s.on(MsgID.TAKEOFF_EQUIP, self._on_takeoff_equip)
+        s.on(MsgID.REQ_ACCEPT_TASK, self._on_accept_task)
+        s.on(MsgID.REQ_COMPLETE_TASK, self._on_complete_task)
+        s.on(MsgID.REQ_CREATE_TEAM, self._on_create_team)
+        s.on(MsgID.REQ_JOIN_TEAM, self._on_join_team)
+        s.on(MsgID.REQ_LEAVE_TEAM, self._on_leave_team)
+        s.on(MsgID.REQ_OPRMEMBER_TEAM, self._on_opr_team_member)
+        s.on(MsgID.REQ_CREATE_GUILD, self._on_create_guild)
+        s.on(MsgID.REQ_JOIN_GUILD, self._on_join_guild)
+        s.on(MsgID.REQ_LEAVE_GUILD, self._on_leave_guild)
+        s.on(MsgID.REQ_SEARCH_GUILD, self._on_search_guild)
         s.on(MsgID.REQ_BUY_FORM_SHOP, self._on_slg_buy)
         s.on(MsgID.REQ_MOVE_BUILD_OBJECT, self._on_slg_move)
         s.on(MsgID.REQ_UP_BUILD_LVL, self._on_slg_upgrade)
@@ -614,8 +654,6 @@ class GameRole(ServerRole):
                            body: bytes) -> None:
         """NFCHeroModule::OnSetFightHeroMsg — the hero's record row rides
         heroid.index (heroes are row-identified)."""
-        from ..wire import ReqSetFightHero
-
         base, req = unwrap(body, ReqSetFightHero)
         sess = self.sessions.get(_ident_key(base.player_id))
         if sess is None or sess.guid is None or req.heroid is None:
@@ -624,6 +662,234 @@ class GameRole(ServerRole):
         if heroes is not None:
             heroes.set_fight_hero(sess.guid, int(req.heroid.index),
                                   int(req.fight_pos))
+
+    # ---------------------------------------------- middleware handlers
+    # reference: NFCItemModule::OnClientUseItem, NFCEquipModule wear /
+    # takeoff callbacks, NFCTaskModule::OnClientAcceptTask /
+    # OnClientCompeleteTask, NFCTeamModule and the guild handlers.  All
+    # degrade to no-ops when the world was assembled without the
+    # middleware stack (bench worlds).
+    def _mid_session(self, base) -> Optional[Session]:
+        sess = self.sessions.get(_ident_key(base.player_id))
+        if sess is None or sess.guid is None:
+            return None
+        return sess
+
+    def _on_use_item(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        base, req = unwrap(body, ReqAckUseItem)
+        sess = self._mid_session(base)
+        items = self.game_world.items
+        if sess is None or items is None or req.item is None:
+            return
+        config_id = req.item.item_id.decode("utf-8", "replace")
+        # row targets are tagged with svrid == 1 (ROW_TARGET_SVRID): row 0
+        # is a VALID first record row, and protoc clients always send the
+        # required targetid field (zeroed when untargeted), so the index
+        # alone cannot discriminate "no target" from "row 0"
+        target = (int(req.targetid.index)
+                  if (req.targetid is not None
+                      and int(req.targetid.svrid) == ROW_TARGET_SVRID)
+                  else None)
+        if items.use_item(sess.guid, config_id, target=target):
+            req.user = guid_ident(sess.guid)
+            self._send_to_session(sess, MsgID.ACK_ITEM_OBJECT, req)
+
+    def _on_wear_equip(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        base, req = unwrap(body, ReqWearEquip)
+        sess = self._mid_session(base)
+        equip = self.game_world.equip
+        if sess is None or equip is None or req.equipid is None:
+            return
+        equip.wear(sess.guid, int(req.equipid.index))
+
+    def _on_takeoff_equip(self, conn_id: int, _msg_id: int,
+                          body: bytes) -> None:
+        base, req = unwrap(body, TakeOffEquip)
+        sess = self._mid_session(base)
+        equip = self.game_world.equip
+        if sess is None or equip is None or req.equipid is None:
+            return
+        equip.take_off(sess.guid, int(req.equipid.index))
+
+    def _on_accept_task(self, conn_id: int, _msg_id: int,
+                        body: bytes) -> None:
+        base, req = unwrap(body, ReqAcceptTask)
+        sess = self._mid_session(base)
+        tasks = self.game_world.tasks
+        if sess is None or tasks is None:
+            return
+        tasks.accept(sess.guid, req.task_id.decode("utf-8", "replace"))
+
+    def _on_complete_task(self, conn_id: int, _msg_id: int,
+                          body: bytes) -> None:
+        base, req = unwrap(body, ReqCompeleteTask)
+        sess = self._mid_session(base)
+        tasks = self.game_world.tasks
+        if sess is None or tasks is None:
+            return
+        tasks.draw_award(sess.guid, req.task_id.decode("utf-8", "replace"))
+
+    # ------------------------------------------------------------- teams
+    def _team_info(self, info) -> "TeamInfo":
+        k = self.kernel
+        members = []
+        for m in info.members:
+            if m not in k.store.guid_map:
+                continue
+            members.append(TeammemberInfo(
+                player_id=guid_ident(m),
+                name=str(k.get_property(m, "Name")).encode(),
+                nLevel=int(k.get_property(m, "Level")),
+                job=int(k.get_property(m, "Job")),
+            ))
+        return TeamInfo(
+            team_id=guid_ident(info.group_id),
+            captain_id=guid_ident(info.leader),
+            teammemberInfo=members,
+        )
+
+    def _on_create_team(self, conn_id: int, _msg_id: int,
+                        body: bytes) -> None:
+        base, _req = unwrap(body, ReqAckCreateTeam)
+        sess = self._mid_session(base)
+        team = self.game_world.team
+        if sess is None or team is None:
+            return
+        tid = team.create_team(sess.guid)
+        if tid is None:
+            return
+        info = team.team_of(sess.guid)
+        self._send_to_session(
+            sess, MsgID.ACK_CREATE_TEAM,
+            ReqAckCreateTeam(team_id=guid_ident(tid),
+                             xTeamInfo=self._team_info(info)),
+        )
+
+    def _on_join_team(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        base, req = unwrap(body, ReqAckJoinTeam)
+        sess = self._mid_session(base)
+        team = self.game_world.team
+        if sess is None or team is None or req.team_id is None:
+            return
+        tid = self._guid_of_ident(req.team_id)
+        if not team.join(tid, sess.guid):
+            return
+        info = team.team_of(sess.guid)
+        ack = ReqAckJoinTeam(team_id=req.team_id,
+                             xTeamInfo=self._team_info(info))
+        # the whole roster hears about the new member
+        self._broadcast(list(info.members), MsgID.ACK_JOIN_TEAM, ack)
+
+    def _on_leave_team(self, conn_id: int, _msg_id: int,
+                       body: bytes) -> None:
+        base, req = unwrap(body, ReqAckLeaveTeam)
+        sess = self._mid_session(base)
+        team = self.game_world.team
+        if sess is None or team is None:
+            return
+        info = team.team_of(sess.guid)
+        if info is None or not team.leave(sess.guid):
+            return
+        ack = ReqAckLeaveTeam(team_id=guid_ident(info.group_id))
+        self._broadcast(list(info.members) + [sess.guid],
+                        MsgID.ACK_LEAVE_TEAM, ack)
+
+    def _on_opr_team_member(self, conn_id: int, _msg_id: int,
+                            body: bytes) -> None:
+        """Captain member ops — KICK/KICKOUT implemented (the other
+        EGTeamMemberOprType values are fight-position bookkeeping the
+        line-up record owns here)."""
+        base, req = unwrap(body, ReqAckOprTeamMember)
+        sess = self._mid_session(base)
+        team = self.game_world.team
+        if sess is None or team is None or req.member_id is None:
+            return
+        if int(req.type) not in (2, 8):  # EGAT_KICK / EGAT_KICKOUT
+            return
+        info = team.team_of(sess.guid)
+        if info is None or info.leader != sess.guid:
+            return  # only the captain operates members
+        member = self._guid_of_ident(req.member_id)
+        if member == sess.guid or member not in info.members:
+            return
+        team.leave(member)
+        ack = ReqAckOprTeamMember(team_id=guid_ident(info.group_id),
+                                  member_id=req.member_id, type=req.type,
+                                  xTeamInfo=self._team_info(info))
+        self._broadcast(list(info.members) + [member],
+                        MsgID.ACK_OPRMEMBER_TEAM, ack)
+
+    # ------------------------------------------------------------ guilds
+    def _on_create_guild(self, conn_id: int, _msg_id: int,
+                         body: bytes) -> None:
+        base, req = unwrap(body, ReqAckCreateGuild)
+        sess = self._mid_session(base)
+        guilds = self.game_world.guilds
+        if sess is None or guilds is None:
+            return
+        name = req.guild_name.decode("utf-8", "replace")
+        gid = guilds.create_guild(sess.guid, name)
+        if gid is None:
+            return
+        self._send_to_session(
+            sess, MsgID.ACK_CREATE_GUILD,
+            ReqAckCreateGuild(guild_id=guid_ident(gid),
+                              guild_name=req.guild_name),
+        )
+
+    def _on_join_guild(self, conn_id: int, _msg_id: int,
+                       body: bytes) -> None:
+        base, req = unwrap(body, ReqAckJoinGuild)
+        sess = self._mid_session(base)
+        guilds = self.game_world.guilds
+        if sess is None or guilds is None:
+            return
+        name = req.guild_name.decode("utf-8", "replace")
+        info = guilds.find_by_name(name)
+        if info is None or not guilds.join(info.group_id, sess.guid):
+            return
+        self._send_to_session(
+            sess, MsgID.ACK_JOIN_GUILD,
+            ReqAckJoinGuild(guild_id=guid_ident(info.group_id),
+                            guild_name=req.guild_name),
+        )
+
+    def _on_leave_guild(self, conn_id: int, _msg_id: int,
+                        body: bytes) -> None:
+        base, req = unwrap(body, ReqAckLeaveGuild)
+        sess = self._mid_session(base)
+        guilds = self.game_world.guilds
+        if sess is None or guilds is None:
+            return
+        info = guilds.guild_of(sess.guid)
+        if info is None or not guilds.leave(sess.guid):
+            return
+        self._send_to_session(
+            sess, MsgID.ACK_LEAVE_GUILD,
+            ReqAckLeaveGuild(guild_id=guid_ident(info.group_id),
+                             guild_name=info.name.encode()),
+        )
+
+    def _on_search_guild(self, conn_id: int, _msg_id: int,
+                         body: bytes) -> None:
+        base, req = unwrap(body, ReqSearchGuild)
+        sess = self._mid_session(base)
+        guilds = self.game_world.guilds
+        if sess is None or guilds is None:
+            return
+        needle = req.guild_name.decode("utf-8", "replace").lower()
+        out = []
+        for info in guilds.guilds.values():
+            if needle and needle not in info.name.lower():
+                continue
+            out.append(SearchGuildObject(
+                guild_ID=guid_ident(info.group_id),
+                guild_name=info.name.encode(),
+                guild_member_count=len(info.members),
+                guild_member_max_count=info.capacity,
+            ))
+        self._send_to_session(sess, MsgID.ACK_SEARCH_GUILD,
+                              AckSearchGuild(guild_list=out))
 
     # ---------------------------------------------- cross-server switch
     # Reference NFCGSSwichServerModule.cpp: game A serializes nothing and
@@ -636,7 +902,6 @@ class GameRole(ServerRole):
                       scene_id: int = 1, group: int = 0) -> bool:
         """ChangeServer (NFCGSSwichServerModule.cpp:49-77)."""
         from ...persist.codec import snapshot_object
-        from ..wire import ReqSwitchServer, SwitchServerData
 
         key = self._guid_session.get(guid)
         sess = self.sessions.get(key) if key is not None else None
@@ -674,8 +939,6 @@ class GameRole(ServerRole):
     def _on_client_switch(self, conn_id: int, _msg_id: int,
                           body: bytes) -> None:
         """Client-initiated switch (OnClientReqSwichServer)."""
-        from ..wire import ReqSwitchServer
-
         base, req = unwrap(body, ReqSwitchServer)
         sess = self.sessions.get(_ident_key(base.player_id))
         if sess is None or sess.guid is None:
@@ -686,8 +949,6 @@ class GameRole(ServerRole):
     SWITCH_BLOB_TTL_S = 30.0
 
     def _on_switch_data(self, _sid: int, _msg_id: int, body: bytes) -> None:
-        from ..wire import SwitchServerData
-
         _, data = unwrap(body, SwitchServerData)
         if int(data.target_serverid) != self.config.server_id:
             return
@@ -705,8 +966,6 @@ class GameRole(ServerRole):
         NFCGSSwichServerModule.cpp:96-148): recreate the player from the
         blob, enter the scene, bind the client, re-route the proxy, ack."""
         from ...persist.codec import apply_snapshot
-        from ..wire import AckSwitchServer, ReqSwitchServer
-
         _, req = unwrap(body, ReqSwitchServer)
         if int(req.target_serverid) != self.config.server_id:
             return
@@ -759,8 +1018,6 @@ class GameRole(ServerRole):
     def _on_switch_ack(self, _sid: int, _msg_id: int, body: bytes) -> None:
         """Origin side (OnAckSwichServer): the target owns the player
         now — drop the session binding and the local object."""
-        from ..wire import AckSwitchServer
-
         _, ack = unwrap(body, AckSwitchServer)
         if int(ack.self_serverid) != self.config.server_id:
             return

@@ -811,6 +811,168 @@ class ReqAckSwapScene(Message):
     ]
 
 
+class ItemStruct(Message):
+    """`NFMsgShare.proto:155-159` — config id + count."""
+
+    FIELDS = [
+        (1, "item_id", "string", b""),
+        (2, "item_count", "int32", 0),
+    ]
+
+
+class ReqAckUseItem(Message):
+    """Use-item request/ack (`NFMsgShare.proto:128-135`,
+    EGMI_REQ_ITEM_OBJECT).  Items are ConfigID-keyed stackables here, so
+    `item.item_id` names what to use; family-specific targets (hero row,
+    equip row) ride `targetid.index` (svrid 0)."""
+
+    FIELDS = [
+        (1, "user", Ident, None),
+        (2, "item_guid", Ident, None),
+        (3, "effect_data", R(EffectData), None),
+        (4, "item", ItemStruct, None),
+        (5, "targetid", Ident, None),
+    ]
+
+
+class ReqWearEquip(Message):
+    """`NFMsgShare.proto:489-495`, EGEC_WEAR_EQUIP — the BagEquipList
+    row rides `equipid.index` (row-identified equips)."""
+
+    FIELDS = [
+        (1, "selfid", Ident, None),
+        (2, "equipid", Ident, None),
+        (3, "target_id", Ident, None),
+    ]
+
+
+class TakeOffEquip(Message):
+    """`NFMsgShare.proto:498-503`, EGEC_TAKEOFF_EQUIP."""
+
+    FIELDS = [
+        (1, "selfid", Ident, None),
+        (2, "equipid", Ident, None),
+        (3, "target_id", Ident, None),
+    ]
+
+
+class ReqAcceptTask(Message):
+    """`NFMsgShare.proto:183-186`, EGMI_REQ_ACCEPT_TASK."""
+
+    FIELDS = [(1, "task_id", "bytes", b"")]
+
+
+class ReqCompeleteTask(Message):
+    """`NFMsgShare.proto:188-191` (reference's own spelling),
+    EGMI_REQ_COMPELETE_TASK — claim the award of a DONE task."""
+
+    FIELDS = [(1, "task_id", "bytes", b"")]
+
+
+class TeammemberInfo(Message):
+    """`NFMsgShare.proto:555-562`."""
+
+    FIELDS = [
+        (1, "player_id", Ident, None),
+        (2, "name", "string", b""),
+        (3, "nLevel", "int32", 0),
+        (4, "job", "int32", 0),
+        (5, "HeadIcon", "string", b""),
+    ]
+
+
+class TeamInfo(Message):
+    """`NFMsgShare.proto:548-553`."""
+
+    FIELDS = [
+        (1, "team_id", Ident, None),
+        (2, "captain_id", Ident, None),
+        (3, "teammemberInfo", R(TeammemberInfo), None),
+    ]
+
+
+class ReqAckCreateTeam(Message):
+    """`NFMsgShare.proto:566-570`, EGMI_REQ/ACK_CREATE_TEAM."""
+
+    FIELDS = [
+        (1, "team_id", Ident, None),
+        (2, "xTeamInfo", TeamInfo, None),
+    ]
+
+
+class ReqAckJoinTeam(Message):
+    FIELDS = [
+        (1, "team_id", Ident, None),
+        (2, "xTeamInfo", TeamInfo, None),
+    ]
+
+
+class ReqAckLeaveTeam(Message):
+    FIELDS = [
+        (1, "team_id", Ident, None),
+        (2, "xTeamInfo", TeamInfo, None),
+    ]
+
+
+class ReqAckOprTeamMember(Message):
+    """`NFMsgShare.proto:591-612`, EGMI_REQ/ACK_OPRMEMBER_TEAM —
+    captain member operations (KICK etc.)."""
+
+    FIELDS = [
+        (1, "team_id", Ident, None),
+        (2, "member_id", Ident, None),
+        (3, "type", "enum", 0),
+        (4, "xTeamInfo", TeamInfo, None),
+    ]
+
+
+class ReqAckCreateGuild(Message):
+    """`NFMsgShare.proto:235-239`, EGMI_REQ/ACK_CREATE_GUILD."""
+
+    FIELDS = [
+        (1, "guild_id", Ident, None),
+        (2, "guild_name", "string", b""),
+    ]
+
+
+class ReqAckJoinGuild(Message):
+    FIELDS = [
+        (1, "guild_id", Ident, None),
+        (2, "guild_name", "string", b""),
+    ]
+
+
+class ReqAckLeaveGuild(Message):
+    FIELDS = [
+        (1, "guild_id", Ident, None),
+        (2, "guild_name", "string", b""),
+    ]
+
+
+class ReqSearchGuild(Message):
+    """`NFMsgShare.proto:241-244`, EGMI_REQ_SEARCH_GUILD."""
+
+    FIELDS = [(1, "guild_name", "string", b"")]
+
+
+class SearchGuildObject(Message):
+    """Nested result row of AckSearchGuild (`NFMsgShare.proto:247-257`)."""
+
+    FIELDS = [
+        (1, "guild_ID", Ident, None),
+        (2, "guild_name", "string", b""),
+        (3, "guild_icon", "string", b""),
+        (4, "guild_member_count", "int32", 0),
+        (5, "guild_member_max_count", "int32", 0),
+        (6, "guild_honor", "int32", 0),
+        (7, "guild_rank", "int32", 0),
+    ]
+
+
+class AckSearchGuild(Message):
+    FIELDS = [(1, "guild_list", R(SearchGuildObject), None)]
+
+
 def wrap(msg: Message, player_id: Optional[Ident] = None, clients=None,
          hash_ident: Optional[Ident] = None) -> bytes:
     """Encode a payload inside the MsgBase envelope (SendMsgPB path,

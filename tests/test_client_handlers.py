@@ -1,0 +1,262 @@
+"""Client wire handlers for the middleware surface: use-item, equip
+wear/takeoff, tasks, teams, guilds — the receive-callback set the
+reference's game server registers (NFCItemModule::OnClientUseItem,
+NFCEquipModule, NFCTaskModule, NFCTeamModule, guild handlers)."""
+
+from __future__ import annotations
+
+import pytest
+
+from noahgameframe_tpu.game import (
+    GameWorld,
+    ItemSubType,
+    ItemType,
+    PropertyGroup,
+    TaskDef,
+    TaskState,
+    WorldConfig,
+)
+from noahgameframe_tpu.net.defines import MsgID
+from noahgameframe_tpu.net.roles.base import RoleConfig
+from noahgameframe_tpu.net.roles.game import GameRole, Session
+from noahgameframe_tpu.net.transport import EV_MSG, NetEvent
+from noahgameframe_tpu.net.wire import (
+    AckSearchGuild,
+    Ident,
+    ItemStruct,
+    MsgBase,
+    ReqAcceptTask,
+    ReqAckCreateGuild,
+    ReqAckCreateTeam,
+    ReqAckJoinGuild,
+    ReqAckJoinTeam,
+    ReqAckLeaveGuild,
+    ReqAckLeaveTeam,
+    ReqAckOprTeamMember,
+    ReqAckUseItem,
+    ReqCompeleteTask,
+    ReqSearchGuild,
+    ReqWearEquip,
+    TakeOffEquip,
+    ident_key,
+    unwrap,
+    wrap,
+)
+
+
+@pytest.fixture()
+def rig():
+    world = GameWorld(WorldConfig(combat=False, movement=False, regen=False,
+                                  npc_capacity=64, player_capacity=8)).start()
+    role = GameRole(
+        RoleConfig(6, 0, "MidGame", "127.0.0.1", 0),
+        backend="py", world=world, cross_server_sync=False,
+    )
+    sent = []
+    role.server.send_raw = lambda c, m, b: (sent.append((c, m, b)), True)[1]
+
+    def seat(i, account):
+        ident = Ident(svrid=9, index=i)
+        sess = Session(ident=ident, conn_id=100 + i, account=account)
+        g = role.kernel.create_object(
+            "Player", {"Name": account.title(), "Account": account},
+            scene=1, group=0)
+        sess.guid = g
+        role.sessions[ident_key(ident)] = sess
+        role._guid_session[g] = ident_key(ident)
+        return ident, g
+
+    def send(ident, msg_id, msg):
+        conn = 100 + ident.index
+        role.server.dispatch.feed([
+            NetEvent(EV_MSG, conn, int(msg_id), wrap(msg, player_id=ident))
+        ])
+
+    def acks(conn, msg_id):
+        return [b for c, m, b in sent
+                if c == conn and m == int(msg_id)]
+
+    return world, role, seat, send, acks
+
+
+def test_use_item_and_equip_handlers(rig):
+    world, role, seat, send, acks = rig
+    e = world.kernel.elements
+    e.add_element("Item", "hp_water", {"ItemType": int(ItemType.ITEM),
+                                       "ItemSubType": int(ItemSubType.HP),
+                                       "AwardValue": 30})
+    e.add_element("Item", "axe", {"ItemType": int(ItemType.EQUIP),
+                                  "ATK_VALUE": 6})
+    ident, g = seat(1, "ann")
+    k = world.kernel
+    world.properties.set_group_value(g, "MAXHP", PropertyGroup.EFFECTVALUE,
+                                     100)
+    k.set_property(g, "HP", 10)
+    world.pack.create_item(g, "hp_water", 1)
+    send(ident, MsgID.REQ_ITEM_OBJECT,
+         ReqAckUseItem(item=ItemStruct(item_id=b"hp_water", item_count=1)))
+    assert int(k.get_property(g, "HP")) == 40
+    assert acks(101, MsgID.ACK_ITEM_OBJECT)  # success echoed to the user
+
+    # equip: use the token, wear via the wire, stats fold, then take off
+    world.pack.create_item(g, "axe", 1)
+    send(ident, MsgID.REQ_ITEM_OBJECT,
+         ReqAckUseItem(item=ItemStruct(item_id=b"axe", item_count=1)))
+    row = next(iter(world.pack.equips(g)))
+    send(ident, MsgID.WEAR_EQUIP,
+         ReqWearEquip(equipid=Ident(svrid=0, index=row)))
+    assert world.properties.get_group_value(
+        g, "ATK_VALUE", PropertyGroup.EQUIP) == 6
+    send(ident, MsgID.TAKEOFF_EQUIP,
+         TakeOffEquip(equipid=Ident(svrid=0, index=row)))
+    assert world.properties.get_group_value(
+        g, "ATK_VALUE", PropertyGroup.EQUIP) == 0
+
+
+def test_task_handlers(rig):
+    world, role, seat, send, acks = rig
+    world.tasks.define_task(TaskDef("t1", target_config="", count=1,
+                                    award_exp=0, award_gold=7))
+    ident, g = seat(1, "bob")
+    send(ident, MsgID.REQ_ACCEPT_TASK, ReqAcceptTask(task_id=b"t1"))
+    assert world.tasks.status(g, "t1") == TaskState.IN_PROCESS
+    world.tasks.add_process(g, "t1", 1)
+    assert world.tasks.status(g, "t1") == TaskState.DONE
+    gold0 = int(world.kernel.get_property(g, "Gold"))
+    send(ident, MsgID.REQ_COMPLETE_TASK, ReqCompeleteTask(task_id=b"t1"))
+    assert int(world.kernel.get_property(g, "Gold")) == gold0 + 7
+
+
+def test_team_handlers_create_join_kick_leave(rig):
+    world, role, seat, send, acks = rig
+    cap_ident, cap = seat(1, "cap")
+    mem_ident, mem = seat(2, "mem")
+    send(cap_ident, MsgID.REQ_CREATE_TEAM, ReqAckCreateTeam())
+    ack = acks(101, MsgID.ACK_CREATE_TEAM)
+    assert ack
+    _, created = unwrap(ack[-1], ReqAckCreateTeam)
+    team_id = created.team_id
+
+    send(mem_ident, MsgID.REQ_JOIN_TEAM, ReqAckJoinTeam(team_id=team_id))
+    info = world.team.team_of(mem)
+    assert info is not None and len(info.members) == 2
+    joins = acks(102, MsgID.ACK_JOIN_TEAM)
+    assert joins
+    _, jmsg = unwrap(joins[-1], ReqAckJoinTeam)
+    assert len(jmsg.xTeamInfo.teammemberInfo) == 2  # roster rides the ack
+
+    # a non-captain cannot kick
+    send(mem_ident, MsgID.REQ_OPRMEMBER_TEAM,
+         ReqAckOprTeamMember(team_id=team_id,
+                             member_id=Ident(svrid=cap.head,
+                                             index=cap.data),
+                             type=2))
+    assert len(world.team.team_of(cap).members) == 2
+    # the captain kicks the member
+    send(cap_ident, MsgID.REQ_OPRMEMBER_TEAM,
+         ReqAckOprTeamMember(team_id=team_id,
+                             member_id=Ident(svrid=mem.head,
+                                             index=mem.data),
+                             type=2))
+    assert world.team.team_of(mem) is None
+
+    # leave dissolves the now-single-member team
+    send(cap_ident, MsgID.REQ_LEAVE_TEAM, ReqAckLeaveTeam())
+    assert world.team.team_of(cap) is None
+
+
+def test_guild_handlers_create_join_search_leave(rig):
+    world, role, seat, send, acks = rig
+    lead_ident, lead = seat(1, "lead")
+    mate_ident, mate = seat(2, "mate")
+    send(lead_ident, MsgID.REQ_CREATE_GUILD,
+         ReqAckCreateGuild(guild_name=b"Axiom"))
+    assert acks(101, MsgID.ACK_CREATE_GUILD)
+    assert world.guilds.find_by_name("Axiom") is not None
+
+    send(mate_ident, MsgID.REQ_JOIN_GUILD,
+         ReqAckJoinGuild(guild_name=b"Axiom"))
+    assert len(world.guilds.find_by_name("Axiom").members) == 2
+    assert acks(102, MsgID.ACK_JOIN_GUILD)
+
+    send(mate_ident, MsgID.REQ_SEARCH_GUILD,
+         ReqSearchGuild(guild_name=b"axi"))
+    hits = acks(102, MsgID.ACK_SEARCH_GUILD)
+    assert hits
+    _, found = unwrap(hits[-1], AckSearchGuild)
+    assert [x.guild_name for x in found.guild_list] == [b"Axiom"]
+    assert found.guild_list[0].guild_member_count == 2
+
+    send(mate_ident, MsgID.REQ_LEAVE_GUILD, ReqAckLeaveGuild())
+    assert len(world.guilds.find_by_name("Axiom").members) == 1
+    assert acks(102, MsgID.ACK_LEAVE_GUILD)
+
+
+def test_sdk_guild_team_over_real_sockets():
+    """SDK calls ride the full login pipeline to the middleware handlers
+    (reference NFClient flow against the five-role cluster)."""
+    from noahgameframe_tpu.client import GameClient
+    from noahgameframe_tpu.net.roles import LocalCluster
+
+    c = LocalCluster(http_port=0)
+    c.start(timeout=25.0)
+    try:
+        cli = GameClient("mid")
+        cli.connect("127.0.0.1", c.login.config.port)
+
+        def pump(cond, t=12.0):
+            assert c.pump_until(cond, extra=cli.execute, timeout=t), "timeout"
+
+        pump(lambda: cli.connected)
+        cli.login(); pump(lambda: cli.logged_in)
+        cli.request_world_list(); pump(lambda: cli.worlds)
+        cli.connect_world(cli.worlds[0].server_id)
+        pump(lambda: cli.world_grant is not None)
+        cli.connect_proxy(); pump(lambda: cli.connected)
+        cli.verify_key(); pump(lambda: cli.key_verified)
+        cli.select_server(c.game.config.server_id)
+        pump(lambda: cli.server_selected)
+        cli.create_role("Mid"); pump(lambda: cli.roles)
+        cli.enter_game("Mid"); pump(lambda: cli.entered)
+
+        cli.create_guild("Wire")
+        pump(lambda: cli.guild_acks)
+        cli.search_guild("wir")
+        pump(lambda: cli.guild_search)
+        assert [g.guild_name for g in cli.guild_search[-1].guild_list] \
+            == [b"Wire"]
+
+        cli.create_team()
+        pump(lambda: cli.team_acks)
+        assert cli.team_acks[-1].xTeamInfo is not None
+    finally:
+        c.shut()
+
+
+def test_use_item_targets_row_zero(rig):
+    """Row 0 is a VALID record row: a gem socketed into equip row 0 over
+    the wire must not be coerced to 'untargeted' (review finding — the
+    svrid==1 tag discriminates, since protoc clients always send the
+    required targetid field zeroed)."""
+    world, role, seat, send, acks = rig
+    e = world.kernel.elements
+    e.add_element("Item", "saber", {"ItemType": int(ItemType.EQUIP),
+                                    "ATK_VALUE": 5})
+    e.add_element("Item", "opal", {"ItemType": int(ItemType.GEM),
+                                   "ATK_VALUE": 2})
+    ident, g = seat(1, "zed")
+    row = world.pack.create_equip(g, "saber")
+    assert row == 0  # the first equip lands on record row 0
+    world.pack.create_item(g, "opal", 1)
+    send(ident, MsgID.REQ_ITEM_OBJECT,
+         ReqAckUseItem(item=ItemStruct(item_id=b"opal", item_count=1),
+                       targetid=Ident(svrid=1, index=0)))
+    assert world.items.gems_of(g, 0) == ["opal"]
+    # an explicitly ZEROED ident (what a protoc client sends when it has
+    # no target) must stay untargeted — not become "equip row 0"
+    world.pack.create_item(g, "opal", 1)
+    send(ident, MsgID.REQ_ITEM_OBJECT,
+         ReqAckUseItem(item=ItemStruct(item_id=b"opal", item_count=1),
+                       targetid=Ident(svrid=0, index=0)))
+    assert world.items.gems_of(g, 0) == ["opal"]  # unchanged (gem refused)
+    assert world.pack.item_count(g, "opal") == 1  # stayed in the bag
