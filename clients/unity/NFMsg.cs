@@ -9541,6 +9541,937 @@ namespace NFMsg
         }
     }
 
+    public class ReqCommand
+    {
+        public Ident control_id = new Ident();
+        public bool HasControlId = false;
+        public int command_id = 0;
+        public bool HasCommandId = false;
+        public byte[] command_str_value = Nf.Empty;
+        public bool HasCommandStrValue = false;
+        public long command_value_int = 0;
+        public bool HasCommandValueInt = false;
+        public double command_value_float = 0d;
+        public bool HasCommandValueFloat = false;
+        public byte[] command_value_str = Nf.Empty;
+        public bool HasCommandValueStr = false;
+        public Ident command_value_object = new Ident();
+        public bool HasCommandValueObject = false;
+        public int row = 0;
+        public bool HasRow = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasControlId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); control_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasCommandId)
+            {
+                Nf.PutTag(nf__o, 2, 0);
+                Nf.PutI64(nf__o, (long)command_id);
+            }
+            if (HasCommandStrValue)
+            {
+                Nf.PutTag(nf__o, 3, 2);
+                Nf.PutBytes(nf__o, command_str_value);
+            }
+            if (HasCommandValueInt)
+            {
+                Nf.PutTag(nf__o, 4, 0);
+                Nf.PutI64(nf__o, (long)command_value_int);
+            }
+            if (HasCommandValueFloat)
+            {
+                Nf.PutTag(nf__o, 5, 1);
+                Nf.PutF64(nf__o, command_value_float);
+            }
+            if (HasCommandValueStr)
+            {
+                Nf.PutTag(nf__o, 6, 2);
+                Nf.PutBytes(nf__o, command_value_str);
+            }
+            if (HasCommandValueObject)
+            {
+                Nf.PutTag(nf__o, 7, 2);
+                var nf__sub = new MemoryStream(); command_value_object.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasRow)
+            {
+                Nf.PutTag(nf__o, 8, 0);
+                Nf.PutI64(nf__o, (long)row);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            control_id = new Ident();
+            HasControlId = false;
+            command_id = 0;
+            HasCommandId = false;
+            command_str_value = Nf.Empty;
+            HasCommandStrValue = false;
+            command_value_int = 0;
+            HasCommandValueInt = false;
+            command_value_float = 0d;
+            HasCommandValueFloat = false;
+            command_value_str = Nf.Empty;
+            HasCommandValueStr = false;
+            command_value_object = new Ident();
+            HasCommandValueObject = false;
+            row = 0;
+            HasRow = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        control_id = nf__m; HasControlId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        command_id = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasCommandId = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        command_str_value = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasCommandStrValue = true;
+                        break;
+                    }
+                    case 4:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        command_value_int = (long)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasCommandValueInt = true;
+                        break;
+                    }
+                    case 5:
+                    {
+                        if ((uint)(nf__key & 7) != 1)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        command_value_float = nf__r.F64();
+                        if (!nf__r.Ok) return false;
+                        HasCommandValueFloat = true;
+                        break;
+                    }
+                    case 6:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        command_value_str = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasCommandValueStr = true;
+                        break;
+                    }
+                    case 7:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        command_value_object = nf__m; HasCommandValueObject = true;
+                        break;
+                    }
+                    case 8:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        row = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasRow = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class PVPRoomInfo
+    {
+        public int nCellStatus = 0;
+        public bool HasNCellStatus = false;
+        public Ident RoomID = new Ident();
+        public bool HasRoomID = false;
+        public int nPVPMode = 0;
+        public bool HasNPVPMode = false;
+        public int nPVPGrade = 0;
+        public bool HasNPVPGrade = false;
+        public int MaxPalyer = 0;
+        public bool HasMaxPalyer = false;
+        public List<Ident> xRedPlayer = new List<Ident>();
+        public List<Ident> xBluePlayer = new List<Ident>();
+        public long serverid = 0;
+        public bool HasServerid = false;
+        public long SceneID = 0;
+        public bool HasSceneID = false;
+        public long groupID = 0;
+        public bool HasGroupID = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasNCellStatus)
+            {
+                Nf.PutTag(nf__o, 1, 0);
+                Nf.PutI64(nf__o, (long)nCellStatus);
+            }
+            if (HasRoomID)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                var nf__sub = new MemoryStream(); RoomID.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasNPVPMode)
+            {
+                Nf.PutTag(nf__o, 3, 0);
+                Nf.PutI64(nf__o, (long)nPVPMode);
+            }
+            if (HasNPVPGrade)
+            {
+                Nf.PutTag(nf__o, 4, 0);
+                Nf.PutI64(nf__o, (long)nPVPGrade);
+            }
+            if (HasMaxPalyer)
+            {
+                Nf.PutTag(nf__o, 5, 0);
+                Nf.PutI64(nf__o, (long)MaxPalyer);
+            }
+            foreach (var nf__it in xRedPlayer)
+            {
+                Nf.PutTag(nf__o, 6, 2);
+                var nf__sub = new MemoryStream(); nf__it.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            foreach (var nf__it in xBluePlayer)
+            {
+                Nf.PutTag(nf__o, 7, 2);
+                var nf__sub = new MemoryStream(); nf__it.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasServerid)
+            {
+                Nf.PutTag(nf__o, 8, 0);
+                Nf.PutI64(nf__o, (long)serverid);
+            }
+            if (HasSceneID)
+            {
+                Nf.PutTag(nf__o, 9, 0);
+                Nf.PutI64(nf__o, (long)SceneID);
+            }
+            if (HasGroupID)
+            {
+                Nf.PutTag(nf__o, 10, 0);
+                Nf.PutI64(nf__o, (long)groupID);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            nCellStatus = 0;
+            HasNCellStatus = false;
+            RoomID = new Ident();
+            HasRoomID = false;
+            nPVPMode = 0;
+            HasNPVPMode = false;
+            nPVPGrade = 0;
+            HasNPVPGrade = false;
+            MaxPalyer = 0;
+            HasMaxPalyer = false;
+            xRedPlayer.Clear();
+            xBluePlayer.Clear();
+            serverid = 0;
+            HasServerid = false;
+            SceneID = 0;
+            HasSceneID = false;
+            groupID = 0;
+            HasGroupID = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        nCellStatus = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasNCellStatus = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        RoomID = nf__m; HasRoomID = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        nPVPMode = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasNPVPMode = true;
+                        break;
+                    }
+                    case 4:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        nPVPGrade = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasNPVPGrade = true;
+                        break;
+                    }
+                    case 5:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        MaxPalyer = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasMaxPalyer = true;
+                        break;
+                    }
+                    case 6:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        xRedPlayer.Add(nf__m);
+                        break;
+                    }
+                    case 7:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        xBluePlayer.Add(nf__m);
+                        break;
+                    }
+                    case 8:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        serverid = (long)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasServerid = true;
+                        break;
+                    }
+                    case 9:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        SceneID = (long)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasSceneID = true;
+                        break;
+                    }
+                    case 10:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        groupID = (long)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasGroupID = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqPVPApplyMatch
+    {
+        public Ident self_id = new Ident();
+        public bool HasSelfId = false;
+        public int nPVPMode = 0;
+        public bool HasNPVPMode = false;
+        public long score = 0;
+        public bool HasScore = false;
+        public int ApplyType = 0;
+        public bool HasApplyType = false;
+        public Ident team_id = new Ident();
+        public bool HasTeamId = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasSelfId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); self_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasNPVPMode)
+            {
+                Nf.PutTag(nf__o, 2, 0);
+                Nf.PutI64(nf__o, (long)nPVPMode);
+            }
+            if (HasScore)
+            {
+                Nf.PutTag(nf__o, 3, 0);
+                Nf.PutI64(nf__o, (long)score);
+            }
+            if (HasApplyType)
+            {
+                Nf.PutTag(nf__o, 4, 0);
+                Nf.PutI64(nf__o, (long)ApplyType);
+            }
+            if (HasTeamId)
+            {
+                Nf.PutTag(nf__o, 5, 2);
+                var nf__sub = new MemoryStream(); team_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            self_id = new Ident();
+            HasSelfId = false;
+            nPVPMode = 0;
+            HasNPVPMode = false;
+            score = 0;
+            HasScore = false;
+            ApplyType = 0;
+            HasApplyType = false;
+            team_id = new Ident();
+            HasTeamId = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        self_id = nf__m; HasSelfId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        nPVPMode = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasNPVPMode = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        score = (long)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasScore = true;
+                        break;
+                    }
+                    case 4:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        ApplyType = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasApplyType = true;
+                        break;
+                    }
+                    case 5:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        team_id = nf__m; HasTeamId = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class AckPVPApplyMatch
+    {
+        public Ident self_id = new Ident();
+        public bool HasSelfId = false;
+        public PVPRoomInfo xRoomInfo = new PVPRoomInfo();
+        public bool HasXRoomInfo = false;
+        public int ApplyType = 0;
+        public bool HasApplyType = false;
+        public int nResult = 0;
+        public bool HasNResult = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasSelfId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); self_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasXRoomInfo)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                var nf__sub = new MemoryStream(); xRoomInfo.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasApplyType)
+            {
+                Nf.PutTag(nf__o, 3, 0);
+                Nf.PutI64(nf__o, (long)ApplyType);
+            }
+            if (HasNResult)
+            {
+                Nf.PutTag(nf__o, 4, 0);
+                Nf.PutI64(nf__o, (long)nResult);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            self_id = new Ident();
+            HasSelfId = false;
+            xRoomInfo = new PVPRoomInfo();
+            HasXRoomInfo = false;
+            ApplyType = 0;
+            HasApplyType = false;
+            nResult = 0;
+            HasNResult = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        self_id = nf__m; HasSelfId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new PVPRoomInfo();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        xRoomInfo = nf__m; HasXRoomInfo = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        ApplyType = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasApplyType = true;
+                        break;
+                    }
+                    case 4:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        nResult = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasNResult = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class ReqCreatePVPEctype
+    {
+        public Ident self_id = new Ident();
+        public bool HasSelfId = false;
+        public PVPRoomInfo xRoomInfo = new PVPRoomInfo();
+        public bool HasXRoomInfo = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasSelfId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); self_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasXRoomInfo)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                var nf__sub = new MemoryStream(); xRoomInfo.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            self_id = new Ident();
+            HasSelfId = false;
+            xRoomInfo = new PVPRoomInfo();
+            HasXRoomInfo = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        self_id = nf__m; HasSelfId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new PVPRoomInfo();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        xRoomInfo = nf__m; HasXRoomInfo = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class AckCreatePVPEctype
+    {
+        public Ident self_id = new Ident();
+        public bool HasSelfId = false;
+        public PVPRoomInfo xRoomInfo = new PVPRoomInfo();
+        public bool HasXRoomInfo = false;
+        public int ApplyType = 0;
+        public bool HasApplyType = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasSelfId)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); self_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasXRoomInfo)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                var nf__sub = new MemoryStream(); xRoomInfo.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasApplyType)
+            {
+                Nf.PutTag(nf__o, 3, 0);
+                Nf.PutI64(nf__o, (long)ApplyType);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            self_id = new Ident();
+            HasSelfId = false;
+            xRoomInfo = new PVPRoomInfo();
+            HasXRoomInfo = false;
+            ApplyType = 0;
+            HasApplyType = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        self_id = nf__m; HasSelfId = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new PVPRoomInfo();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        xRoomInfo = nf__m; HasXRoomInfo = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        ApplyType = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasApplyType = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
     public class SearchGuildObject
     {
         public Ident guild_ID = new Ident();

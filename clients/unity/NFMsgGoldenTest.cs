@@ -98,6 +98,12 @@ public static class NFMsgGoldenTest
             case "ReqAckJoinGuild": { var m = new NFMsg.ReqAckJoinGuild(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "ReqAckLeaveGuild": { var m = new NFMsg.ReqAckLeaveGuild(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "ReqSearchGuild": { var m = new NFMsg.ReqSearchGuild(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqCommand": { var m = new NFMsg.ReqCommand(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "PVPRoomInfo": { var m = new NFMsg.PVPRoomInfo(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqPVPApplyMatch": { var m = new NFMsg.ReqPVPApplyMatch(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "AckPVPApplyMatch": { var m = new NFMsg.AckPVPApplyMatch(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "ReqCreatePVPEctype": { var m = new NFMsg.ReqCreatePVPEctype(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "AckCreatePVPEctype": { var m = new NFMsg.AckCreatePVPEctype(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "SearchGuildObject": { var m = new NFMsg.SearchGuildObject(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "AckSearchGuild": { var m = new NFMsg.AckSearchGuild(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "PackMysqlParam": { var m = new NFMsg.PackMysqlParam(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }

@@ -599,6 +599,7 @@ class MatchTicket:
     player: Guid
     score: int
     queued_at: float
+    mode: int = 0  # players only pair within one PVP mode
 
 
 class PvpMatchModule(Module):
@@ -621,11 +622,12 @@ class PvpMatchModule(Module):
         )
 
     def join_queue(self, player: Guid, score: int,
-                   now: Optional[float] = None) -> bool:
+                   now: Optional[float] = None, mode: int = 0) -> bool:
         if any(t.player == player for t in self.queue):
             return False
-        self.queue.append(MatchTicket(player, int(score),
-                                      _time.monotonic() if now is None else now))
+        self.queue.append(MatchTicket(
+            player, int(score),
+            _time.monotonic() if now is None else now, int(mode)))
         return True
 
     def leave_queue(self, player: Guid) -> bool:
@@ -646,13 +648,13 @@ class PvpMatchModule(Module):
             win_a = self.window + self.widen_per_s * int(now - a.queued_at)
             best = None
             for b in order[i + 1:]:
-                if id(b) in used:
-                    continue
+                if id(b) in used or b.mode != a.mode:
+                    continue  # only pair within one PVP mode
                 gap = b.score - a.score
                 win_b = self.window + self.widen_per_s * int(now - b.queued_at)
                 if gap <= min(win_a, win_b):
                     best = b
-                    break  # sorted: first candidate is the closest
+                    break  # sorted: first same-mode candidate is closest
             if best is not None:
                 used.add(id(a))
                 used.add(id(best))

@@ -186,6 +186,15 @@ class MsgID(enum.IntEnum):
     ACK_ONLINE_NOTIFY = 1290
     ACK_OFFLINE_NOTIFY = 1291
 
+    # GM commands (NFDefine.proto:304-312); only the NORMAL entry point
+    # is registered by the reference's NFCGmModule
+    REQ_CMD_NORMAL = 10008
+    # PVP matchmaking (NFDefine.proto:299-302)
+    REQ_PVP_APPLY_MATCH = 10100
+    ACK_PVP_APPLY_MATCH = 10101
+    REQ_CREATE_PVP_ECTYPE = 10102
+    ACK_CREATE_PVP_ECTYPE = 10103
+
     # SLG city building (NFDefine.proto:292-299 EGMI_REQ_BUY_FORM_SHOP..)
     REQ_BUY_FORM_SHOP = 20000
     ACK_BUY_FORM_SHOP = 20001

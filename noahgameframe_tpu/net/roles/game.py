@@ -212,6 +212,8 @@ class GameRole(ServerRole):
 
             for msg in CROSS_SYNC_MSGS:
                 self.world_link.on(msg, self._on_world_sync)
+        # PVP rooms minted by matchmaking, pending their ectype step
+        self._pvp_rooms: Dict = {}
         # cross-game-server switch (NFCGSSwichServerModule): staged blobs
         # by player ident, world-link handlers for the re-home protocol
         self._switch_blobs: Dict = {}
@@ -303,6 +305,9 @@ class GameRole(ServerRole):
         s.on(MsgID.REQ_JOIN_GUILD, self._on_join_guild)
         s.on(MsgID.REQ_LEAVE_GUILD, self._on_leave_guild)
         s.on(MsgID.REQ_SEARCH_GUILD, self._on_search_guild)
+        s.on(MsgID.REQ_CMD_NORMAL, self._on_gm_command)
+        s.on(MsgID.REQ_PVP_APPLY_MATCH, self._on_pvp_apply)
+        s.on(MsgID.REQ_CREATE_PVP_ECTYPE, self._on_pvp_create_ectype)
         s.on(MsgID.REQ_BUY_FORM_SHOP, self._on_slg_buy)
         s.on(MsgID.REQ_MOVE_BUILD_OBJECT, self._on_slg_move)
         s.on(MsgID.REQ_UP_BUILD_LVL, self._on_slg_upgrade)
@@ -890,6 +895,110 @@ class GameRole(ServerRole):
             ))
         self._send_to_session(sess, MsgID.ACK_SEARCH_GUILD,
                               AckSearchGuild(guild_list=out))
+
+    # --------------------------------------------------------- GM + PVP
+    def _on_gm_command(self, conn_id: int, _msg_id: int,
+                       body: bytes) -> None:
+        """EGMI_REQ_CMD_NORMAL (NFCGmModule::OnGMNormalProcess):
+        ReqCommand's typed EGameCommandType mapped onto GmModule's
+        chat-command grammar, so the GMLevel gate applies identically."""
+        from ..wire import ReqCommand
+
+        base, req = unwrap(body, ReqCommand)
+        sess = self._mid_session(base)
+        gm = self.game_world.gm
+        if sess is None or gm is None:
+            return
+        k = self.kernel
+        sval = (req.command_str_value or b"").decode("utf-8", "replace")
+        ival = int(req.command_value_int or 0)
+        cmd = int(req.command_id)
+        if cmd == 0:  # EGCT_MODIY_PROPERTY: SET the named int property
+            if int(k.get_property(sess.guid, "GMLevel")) < gm.min_gm_level:
+                return
+            spec = k.store.spec("Player")
+            if sval and spec.has_property(sval) \
+                    and spec.slot(sval).prop.type == DataType.INT:
+                k.set_property(sess.guid, sval, ival)
+            return
+        text = {
+            1: f"/item {sval} {ival or 1}",  # EGCT_MODIY_ITEM
+            3: f"/exp {ival}",  # EGCT_ADD_ROLE_EXP
+        }.get(cmd)
+        if text is not None:
+            gm.handle_command(sess.guid, text)
+
+    def _on_pvp_apply(self, conn_id: int, _msg_id: int, body: bytes) -> None:
+        """EGMI_REQ_PVPAPPLYMACTCH (NFCGSPVPMatchModule shape): queue the
+        player; when the score-window pairing matches two, BOTH get an
+        ACK with the room (red/blue) and the role tracks it for the
+        ectype step."""
+        from ..wire import AckPVPApplyMatch, PVPRoomInfo, ReqPVPApplyMatch
+
+        base, req = unwrap(body, ReqPVPApplyMatch)
+        sess = self._mid_session(base)
+        pvp = self.game_world.pvp
+        if sess is None or pvp is None:
+            return
+        score = int(req.score or
+                    self.kernel.get_property(sess.guid, "Level"))
+        pvp.join_queue(sess.guid, score, mode=int(req.nPVPMode))
+        for red, blue in pvp.match_once():
+            room_id = self.kernel.store.guids.next()
+            room = PVPRoomInfo(
+                nCellStatus=0,
+                RoomID=guid_ident(room_id),
+                nPVPMode=int(req.nPVPMode),
+                MaxPalyer=2,
+                xRedPlayer=[guid_ident(red)],
+                xBluePlayer=[guid_ident(blue)],
+                serverid=self.config.server_id,
+            )
+            self._pvp_rooms[(room_id.head, room_id.data)] = (red, blue)
+            ack = AckPVPApplyMatch(xRoomInfo=room,
+                                   ApplyType=int(req.ApplyType), nResult=1)
+            for g in (red, blue):
+                key = self._guid_session.get(g)
+                s2 = self.sessions.get(key) if key is not None else None
+                if s2 is not None:
+                    ack.self_id = guid_ident(g)
+                    self._send_to_session(s2, MsgID.ACK_PVP_APPLY_MATCH, ack)
+
+    def _on_pvp_create_ectype(self, conn_id: int, _msg_id: int,
+                              body: bytes) -> None:
+        """EGMI_REQ_CREATEPVPECTYPE: mint the PVP instance — a CLONE
+        scene group both fighters enter (the reference pulls both sides
+        into the room's ectype scene)."""
+        from ..wire import AckCreatePVPEctype, ReqCreatePVPEctype
+
+        base, req = unwrap(body, ReqCreatePVPEctype)
+        sess = self._mid_session(base)
+        if sess is None or req.xRoomInfo is None or req.xRoomInfo.RoomID is None:
+            return
+        rid = (req.xRoomInfo.RoomID.svrid, req.xRoomInfo.RoomID.index)
+        pair = self._pvp_rooms.get(rid)
+        if pair is None or sess.guid not in pair:
+            return  # unknown room, or a NON-participant: room stays live
+        del self._pvp_rooms[rid]
+        scene_id = int(req.xRoomInfo.SceneID or
+                       self.kernel.get_property(sess.guid, "SceneID"))
+        if scene_id not in self.scene.scenes:
+            self.scene.create_scene(scene_id)
+        # ONE shared instance for both fighters (scene_process.enter
+        # would mint a private clone group per enterer)
+        group = self.scene.request_group(scene_id)
+        for g in pair:
+            if g in self.kernel.store.guid_map:
+                self.scene.enter_scene(g, scene_id, group)
+        req.xRoomInfo.SceneID = scene_id
+        req.xRoomInfo.groupID = group
+        ack = AckCreatePVPEctype(self_id=base.player_id,
+                                 xRoomInfo=req.xRoomInfo)
+        for g in pair:
+            key = self._guid_session.get(g)
+            s2 = self.sessions.get(key) if key is not None else None
+            if s2 is not None:
+                self._send_to_session(s2, MsgID.ACK_CREATE_PVP_ECTYPE, ack)
 
     # ---------------------------------------------- cross-server switch
     # Reference NFCGSSwichServerModule.cpp: game A serializes nothing and

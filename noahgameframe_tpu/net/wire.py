@@ -824,7 +824,10 @@ class ReqAckUseItem(Message):
     """Use-item request/ack (`NFMsgShare.proto:128-135`,
     EGMI_REQ_ITEM_OBJECT).  Items are ConfigID-keyed stackables here, so
     `item.item_id` names what to use; family-specific targets (hero row,
-    equip row) ride `targetid.index` (svrid 0)."""
+    equip row) ride `targetid.index` with `targetid.svrid == 1` (the
+    game role's ROW_TARGET_SVRID tag — row 0 is a valid record row, and
+    a required-field protoc client sends a ZEROED ident when it has no
+    target, so the index alone cannot discriminate)."""
 
     FIELDS = [
         (1, "user", Ident, None),
@@ -953,6 +956,81 @@ class ReqSearchGuild(Message):
     """`NFMsgShare.proto:241-244`, EGMI_REQ_SEARCH_GUILD."""
 
     FIELDS = [(1, "guild_name", "string", b"")]
+
+
+class ReqCommand(Message):
+    """GM command (`NFMsgBase.proto:296-312`, EGMI_REQ_CMD_NORMAL):
+    EGCT_MODIY_PROPERTY / MODIY_ITEM / CREATE_OBJECT / ADD_ROLE_EXP."""
+
+    FIELDS = [
+        (1, "control_id", Ident, None),
+        (2, "command_id", "enum", 0),
+        (3, "command_str_value", "bytes", None),
+        (4, "command_value_int", "int64", None),
+        (5, "command_value_float", "double", None),
+        (6, "command_value_str", "bytes", None),
+        (7, "command_value_object", Ident, None),
+        (8, "row", "int32", None),
+    ]
+
+
+class PVPRoomInfo(Message):
+    """`NFMsgShare.proto:772-784`."""
+
+    FIELDS = [
+        (1, "nCellStatus", "int32", 0),
+        (2, "RoomID", Ident, None),
+        (3, "nPVPMode", "int32", 0),
+        (4, "nPVPGrade", "int32", 0),
+        (5, "MaxPalyer", "int32", 0),
+        (6, "xRedPlayer", R(Ident), None),
+        (7, "xBluePlayer", R(Ident), None),
+        (8, "serverid", "int64", None),
+        (9, "SceneID", "int64", None),
+        (10, "groupID", "int64", None),
+    ]
+
+
+class ReqPVPApplyMatch(Message):
+    """`NFMsgShare.proto:787-801`, EGMI_REQ_PVPAPPLYMACTCH."""
+
+    FIELDS = [
+        (1, "self_id", Ident, None),
+        (2, "nPVPMode", "int32", 0),
+        (3, "score", "int64", None),
+        (4, "ApplyType", "int32", 0),
+        (5, "team_id", Ident, None),
+    ]
+
+
+class AckPVPApplyMatch(Message):
+    """`NFMsgShare.proto:803-810`."""
+
+    FIELDS = [
+        (1, "self_id", Ident, None),
+        (2, "xRoomInfo", PVPRoomInfo, None),
+        (3, "ApplyType", "int32", 0),
+        (4, "nResult", "int32", 0),
+    ]
+
+
+class ReqCreatePVPEctype(Message):
+    """`NFMsgShare.proto:812-817`, EGMI_REQ_CREATEPVPECTYPE."""
+
+    FIELDS = [
+        (1, "self_id", Ident, None),
+        (2, "xRoomInfo", PVPRoomInfo, None),
+    ]
+
+
+class AckCreatePVPEctype(Message):
+    """`NFMsgShare.proto:819-825`."""
+
+    FIELDS = [
+        (1, "self_id", Ident, None),
+        (2, "xRoomInfo", PVPRoomInfo, None),
+        (3, "ApplyType", "int32", 0),
+    ]
 
 
 class SearchGuildObject(Message):

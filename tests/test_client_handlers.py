@@ -260,3 +260,122 @@ def test_use_item_targets_row_zero(rig):
                        targetid=Ident(svrid=0, index=0)))
     assert world.items.gems_of(g, 0) == ["opal"]  # unchanged (gem refused)
     assert world.pack.item_count(g, "opal") == 1  # stayed in the bag
+
+
+def test_gm_command_wire(rig):
+    """EGMI_REQ_CMD_NORMAL: typed GM commands gated by GMLevel."""
+    from noahgameframe_tpu.net.wire import ReqCommand
+
+    world, role, seat, send, acks = rig
+    ident, g = seat(1, "gm")
+    k = world.kernel
+    # without GM level nothing happens
+    send(ident, MsgID.REQ_CMD_NORMAL,
+         ReqCommand(command_id=0, command_str_value=b"Level",
+                    command_value_int=9))
+    assert int(k.get_property(g, "Level")) != 9
+    k.set_property(g, "GMLevel", 1)
+    send(ident, MsgID.REQ_CMD_NORMAL,
+         ReqCommand(command_id=0, command_str_value=b"Level",
+                    command_value_int=9))
+    assert int(k.get_property(g, "Level")) == 9
+    # EGCT_MODIY_ITEM
+    world.kernel.elements.add_element("Item", "gm_box", {"ItemType": 2})
+    send(ident, MsgID.REQ_CMD_NORMAL,
+         ReqCommand(command_id=1, command_str_value=b"gm_box",
+                    command_value_int=3))
+    assert world.pack.item_count(g, "gm_box") == 3
+
+
+def test_pvp_match_and_ectype_wire(rig):
+    """Apply → pair → room ack to both; ectype puts both fighters into
+    ONE shared scene group."""
+    from noahgameframe_tpu.net.wire import (
+        AckPVPApplyMatch,
+        ReqCreatePVPEctype,
+        ReqPVPApplyMatch,
+    )
+
+    world, role, seat, send, acks = rig
+    a_ident, a = seat(1, "reda")
+    b_ident, b = seat(2, "blub")
+    send(a_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=1, score=100))
+    assert not acks(101, MsgID.ACK_PVP_APPLY_MATCH)  # alone: no match yet
+    send(b_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=1, score=120))
+    got_a = acks(101, MsgID.ACK_PVP_APPLY_MATCH)
+    got_b = acks(102, MsgID.ACK_PVP_APPLY_MATCH)
+    assert got_a and got_b  # both sides hear about the room
+    _, ack = unwrap(got_a[-1], AckPVPApplyMatch)
+    assert ack.nResult == 1 and ack.xRoomInfo is not None
+
+    send(a_ident, MsgID.REQ_CREATE_PVP_ECTYPE,
+         ReqCreatePVPEctype(xRoomInfo=ack.xRoomInfo))
+    ect_a = acks(101, MsgID.ACK_CREATE_PVP_ECTYPE)
+    ect_b = acks(102, MsgID.ACK_CREATE_PVP_ECTYPE)
+    assert ect_a and ect_b
+    k = world.kernel
+    assert int(k.get_property(a, "GroupID")) == int(
+        k.get_property(b, "GroupID"))  # one shared instance
+    assert int(k.get_property(a, "GroupID")) > 1  # a fresh group
+    # a second ectype request for the same room is refused (one-shot)
+    n = len(acks(101, MsgID.ACK_CREATE_PVP_ECTYPE))
+    send(a_ident, MsgID.REQ_CREATE_PVP_ECTYPE,
+         ReqCreatePVPEctype(xRoomInfo=ack.xRoomInfo))
+    assert len(acks(101, MsgID.ACK_CREATE_PVP_ECTYPE)) == n
+
+
+def test_pvp_mode_segmentation_and_room_protection(rig):
+    """Different PVP modes never pair (review finding), and a
+    non-participant echoing a RoomID cannot destroy the pending room."""
+    from noahgameframe_tpu.net.wire import (
+        AckPVPApplyMatch,
+        ReqCreatePVPEctype,
+        ReqPVPApplyMatch,
+    )
+
+    world, role, seat, send, acks = rig
+    a_ident, a = seat(1, "ma")
+    b_ident, b = seat(2, "mb")
+    x_ident, x = seat(3, "mx")
+    send(a_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=1, score=100))
+    send(b_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=2, score=100))
+    assert not acks(101, MsgID.ACK_PVP_APPLY_MATCH)  # modes differ: no pair
+    send(x_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=1, score=105))
+    got = acks(101, MsgID.ACK_PVP_APPLY_MATCH)
+    assert got  # same-mode pair a+x formed
+    _, ack = unwrap(got[-1], AckPVPApplyMatch)
+
+    # the mode-2 outsider echoes the room id: the room must survive
+    send(b_ident, MsgID.REQ_CREATE_PVP_ECTYPE,
+         ReqCreatePVPEctype(xRoomInfo=ack.xRoomInfo))
+    assert not acks(102, MsgID.ACK_CREATE_PVP_ECTYPE)
+    send(a_ident, MsgID.REQ_CREATE_PVP_ECTYPE,
+         ReqCreatePVPEctype(xRoomInfo=ack.xRoomInfo))
+    assert acks(101, MsgID.ACK_CREATE_PVP_ECTYPE)  # participants still can
+
+
+def test_gm_modify_property_sets_named_property(rig):
+    """EGCT_MODIY_PROPERTY SETS the named int property — not a gold add
+    (review finding)."""
+    from noahgameframe_tpu.net.wire import ReqCommand
+
+    world, role, seat, send, acks = rig
+    ident, g = seat(1, "gm2")
+    k = world.kernel
+    k.set_property(g, "GMLevel", 1)
+    gold0 = int(k.get_property(g, "Gold"))
+    send(ident, MsgID.REQ_CMD_NORMAL,
+         ReqCommand(command_id=0, command_str_value=b"HP",
+                    command_value_int=55))
+    assert int(k.get_property(g, "HP")) == 55
+    assert int(k.get_property(g, "Gold")) == gold0  # gold untouched
+    # repeating is idempotent (set, not add)
+    send(ident, MsgID.REQ_CMD_NORMAL,
+         ReqCommand(command_id=0, command_str_value=b"HP",
+                    command_value_int=55))
+    assert int(k.get_property(g, "HP")) == 55
