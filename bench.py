@@ -316,9 +316,9 @@ def run_bench(args) -> dict:
     world = build_benchmark_world(n, combat=not args.no_combat, seed=42)
     k = world.kernel
 
-    # compile + warm up the fused loop with the SAME trip count (run_device
-    # caches per n; a different warmup n would leave compile time in the
-    # timed region).
+    # compile + warm up (the trip count is a traced scalar: this ONE
+    # compile serves the timed loop, the single-step pass, and every
+    # latency window below)
     t_c0 = time.perf_counter()
     k.run_device(args.ticks)
     jax.block_until_ready(k.state.classes["NPC"].i32)
@@ -331,14 +331,14 @@ def run_bench(args) -> dict:
 
     # per-tick latency distribution on the single-step path (the latency a
     # 30 Hz world-tick loop would see; run_device amortises dispatch, the
-    # single step does not)
+    # single step does not).  Reuses run_device's one compiled program
+    # with a trip count of 1 — the separately-compiled _trace_step
+    # program was a SECOND multi-minute 1M XLA compile that timed out
+    # whole bench runs over the round-5 tunnel.
     lat_ms: list[float] = []
-    k.compile()
-    k.state, _raw = k._jit_step(k.state)  # warm the single-step compile
-    jax.block_until_ready(k.state.classes["NPC"].i32)
     for _ in range(max(8, min(64, args.ticks))):
         t1 = time.perf_counter()
-        k.state, _raw = k._jit_step(k.state)
+        k.run_device(1, reconcile=False)
         jax.block_until_ready(k.state.classes["NPC"].i32)
         lat_ms.append(1000 * (time.perf_counter() - t1))
     lat_sorted = sorted(lat_ms)
@@ -354,17 +354,32 @@ def run_bench(args) -> dict:
     # chip).  Here each sample is a fused window of `lat_k` ticks in ONE
     # dispatch (run_device), so per-tick RTT pollution is RTT/lat_k;
     # window count adapts to a fixed wall budget, floor 64, cap 256.
-    lat_k = max(1, args.lat_k)
     tick_s_est = max(1e-5, dt / args.ticks)
-    n_windows = int(max(64, min(256, args.lat_budget_s / (lat_k * tick_s_est))))
-    k.run_device(lat_k)  # warm the lat_k-sized fused loop's compile cache
+    if args.lat_k:
+        lat_k = max(1, args.lat_k)
+    else:
+        # auto: size the window so one dispatch RTT (~80 ms over the
+        # tunnel) is ~5% of it — window wall ≈ 1.6 s.  Trip count is a
+        # traced scalar in run_device, so any lat_k reuses the one
+        # compiled program.
+        lat_k = max(4, min(256, int(round(1.6 / tick_s_est))))
+    # floor 24 (p95 stays meaningful, p99 ≈ max) — a 64-window floor at
+    # auto lat_k would run ~5x over lat_budget_s at 1M on the tunnel
+    n_windows = int(max(24, min(256, args.lat_budget_s / (lat_k * tick_s_est))))
+    # reconcile=False: end-of-window death reconciliation is one
+    # device→host fetch per class — over a remote-TPU tunnel that cost
+    # ~1 s per window (r05 measured: 271 ms/tick apparent at 100k vs a
+    # 26 ms fused mean), pure harness artifact.  One reconciling call
+    # after the loop keeps host free-lists exact.
+    k.run_device(lat_k, reconcile=False)  # warm the lat_k-sized compile
     jax.block_until_ready(k.state.classes["NPC"].i32)
     dev_ms: list[float] = []
     for _ in range(n_windows):
         t1 = time.perf_counter()
-        k.run_device(lat_k)
+        k.run_device(lat_k, reconcile=False)
         jax.block_until_ready(k.state.classes["NPC"].i32)
         dev_ms.append(1000 * (time.perf_counter() - t1) / lat_k)
+    k.run_device(1)  # reconcile host free-lists once, outside timing
     dev_sorted = sorted(dev_ms)
 
     def dpct(p: float) -> float:
@@ -536,9 +551,10 @@ def main() -> None:
              "streams (quantized) instead of group-wide broadcast",
     )
     ap.add_argument(
-        "--lat-k", type=int, default=4,
+        "--lat-k", type=int, default=0,
         help="ticks per fused window in the device-honest latency "
-             "sampler (per-tick RTT pollution = one dispatch / lat-k)",
+             "sampler (per-tick RTT pollution = one dispatch / lat-k); "
+             "0 = auto-size for ~1.6 s windows",
     )
     ap.add_argument(
         "--lat-budget-s", type=float, default=20.0,

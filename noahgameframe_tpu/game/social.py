@@ -638,9 +638,18 @@ class PvpMatchModule(Module):
     def match_once(self, now: Optional[float] = None) -> List[Tuple[Guid, Guid]]:
         """Pair greedily by score; each ticket's acceptable window widens
         with wait time.  Returns the new pairs (also kept in .matches)."""
+        return [(a.player, b.player)
+                for a, b in self.match_once_tickets(now)]
+
+    def match_once_tickets(
+        self, now: Optional[float] = None
+    ) -> List[Tuple[MatchTicket, MatchTicket]]:
+        """match_once, but returning the full tickets — consumers that
+        label the match (room mode = the PAIR's queue mode, not the
+        triggering request's) need more than the guids."""
         now = _time.monotonic() if now is None else now
         order = sorted(self.queue, key=lambda t: t.score)
-        paired: List[Tuple[Guid, Guid]] = []
+        paired: List[Tuple[MatchTicket, MatchTicket]] = []
         used = set()
         for i, a in enumerate(order):
             if id(a) in used:
@@ -658,10 +667,12 @@ class PvpMatchModule(Module):
             if best is not None:
                 used.add(id(a))
                 used.add(id(best))
-                paired.append((a.player, best.player))
+                paired.append((a, best))
         if paired:
-            matched_players = {p for pair in paired for p in pair}
+            matched_players = {t.player for pair in paired for t in pair}
             self.queue = [t for t in self.queue
                           if t.player not in matched_players]
-            self.matches.extend(paired)
+            self.matches.extend(
+                (a.player, b.player) for a, b in paired
+            )
         return paired

@@ -148,7 +148,6 @@ class Kernel(Module):
         self._composed: List[Phase] = []
         self._jit_step = None
         self._jit_run = None
-        self._jit_run_n: Optional[int] = None
         self._class_event_subs: List[ClassEventFn] = []
         self._class_event_by_class: Dict[str, List[ClassEventFn]] = {}
         self._prop_event_subs: Dict[Tuple[str, str], List[PropertyEventFn]] = {}
@@ -364,28 +363,38 @@ class Kernel(Module):
         self._post_tick(out, np.asarray(raw["summary"]))
         return out
 
-    def run_device(self, n: int) -> int:
+    def run_device(self, n: int, reconcile: bool = True) -> int:
         """Advance n frames entirely on device (lax.fori_loop over the
         step) with ZERO host syncs — the headless/benchmark fast path.
 
         Per-tick host observation is skipped: device events, per-tick
         diffs and fired masks are not delivered (XLA dead-code-eliminates
         them); deaths are reconciled once at the end.  Use tick() when
-        host subscribers must see every frame."""
+        host subscribers must see every frame.
+
+        reconcile=False skips the end-of-run death reconciliation (one
+        device→host fetch per class — ~4 tunnel RTTs on a remote chip,
+        which would dominate short timing windows).  Host free-lists then
+        lag the device until the next reconciling call; benchmark latency
+        sampling is the intended user."""
         self.compile()
         key = int(n)
-        if self._jit_run is None or self._jit_run_n != key:
-
+        if self._jit_run is None:
+            # trip count rides in as a TRACED scalar so ONE compile
+            # serves every n — a fresh 1M-entity compile per window size
+            # cost the round-4 bench minutes of wall per variant
             def body(_, st):
                 st2, _out = self._trace_step(st)
                 return st2
 
             self._jit_run = jax.jit(
-                lambda st: jax.lax.fori_loop(0, key, body, st), donate_argnums=0
+                lambda st, k: jax.lax.fori_loop(0, k, body, st),
+                donate_argnums=0,
             )
-            self._jit_run_n = key
-        self.state = self._jit_run(self.state)
+        self.state = self._jit_run(self.state, jnp.int32(key))
         self.tick_count += key
+        if not reconcile:
+            return 0
         freed = 0
         for cname in self.store.class_order:
             for g in self.store.reconcile_deaths(self.state, cname):

@@ -502,6 +502,28 @@ class GameRole(ServerRole):
         # stale seen-state would suppress every stationary entity forever
         sess._interest_seen = {}
         self._guid_session.pop(guid, None)
+        # PVP hygiene: a queued ticket would ghost-match a gone player,
+        # and an unconsumed room entry would leak forever
+        pvp = getattr(self.game_world, "pvp", None)
+        if pvp is not None:
+            pvp.leave_queue(guid)
+        for rid, pair in list(self._pvp_rooms.items()):
+            if guid in pair:
+                del self._pvp_rooms[rid]
+                # the surviving fighter must hear the match died, or
+                # they wait on a room that can never mint its ectype
+                for other in pair:
+                    if other == guid:
+                        continue
+                    key = self._guid_session.get(other)
+                    s2 = self.sessions.get(key) if key is not None else None
+                    if s2 is not None:
+                        from ..wire import AckPVPApplyMatch
+
+                        self._send_to_session(
+                            s2, MsgID.ACK_PVP_APPLY_MATCH,
+                            AckPVPApplyMatch(nResult=0),  # cancelled
+                        )
         if guid in self.kernel.store.guid_map:
             self.kernel.destroy_object(guid)
         leave = AckPlayerLeaveList(object_list=[guid_ident(guid)])
@@ -940,15 +962,21 @@ class GameRole(ServerRole):
         pvp = self.game_world.pvp
         if sess is None or pvp is None:
             return
-        score = int(req.score or
-                    self.kernel.get_property(sess.guid, "Level"))
-        pvp.join_queue(sess.guid, score, mode=int(req.nPVPMode))
-        for red, blue in pvp.match_once():
+        score = int(self.kernel.get_property(sess.guid, "Level")
+                    if req.score is None else req.score)  # 0 is a real rating
+        if not pvp.join_queue(sess.guid, score, mode=int(req.nPVPMode)):
+            # already queued: re-apply means switch (new mode/score wins)
+            pvp.leave_queue(sess.guid)
+            pvp.join_queue(sess.guid, score, mode=int(req.nPVPMode))
+        for ta, tb in pvp.match_once_tickets():
+            red, blue = ta.player, tb.player
             room_id = self.kernel.store.guids.next()
             room = PVPRoomInfo(
                 nCellStatus=0,
                 RoomID=guid_ident(room_id),
-                nPVPMode=int(req.nPVPMode),
+                # the PAIR's queue mode — window-widening can match a
+                # pair during someone else's request
+                nPVPMode=ta.mode,
                 MaxPalyer=2,
                 xRedPlayer=[guid_ident(red)],
                 xBluePlayer=[guid_ident(blue)],
@@ -992,12 +1020,12 @@ class GameRole(ServerRole):
                 self.scene.enter_scene(g, scene_id, group)
         req.xRoomInfo.SceneID = scene_id
         req.xRoomInfo.groupID = group
-        ack = AckCreatePVPEctype(self_id=base.player_id,
-                                 xRoomInfo=req.xRoomInfo)
+        ack = AckCreatePVPEctype(xRoomInfo=req.xRoomInfo)
         for g in pair:
             key = self._guid_session.get(g)
             s2 = self.sessions.get(key) if key is not None else None
             if s2 is not None:
+                ack.self_id = guid_ident(g)  # per-recipient, like apply
                 self._send_to_session(s2, MsgID.ACK_CREATE_PVP_ECTYPE, ack)
 
     # ---------------------------------------------- cross-server switch

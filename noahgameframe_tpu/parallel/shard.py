@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -88,7 +89,6 @@ class ShardedKernel:
             )
         self._jit_step = None
         self._jit_run = None
-        self._jit_run_n = None
 
     # -- placement -----------------------------------------------------------
 
@@ -173,7 +173,10 @@ class ShardedKernel:
                 self.kernel.state = step(self.kernel.state)
             self.kernel.tick_count += key
             return
-        if self._jit_run is None or self._jit_run_n != key:
+        if self._jit_run is None:
+            # traced trip count: one compile serves every n (matches
+            # Kernel.run_device; a per-n recompile at 512k x 8 devices
+            # is ~minutes of XLA wall)
             shardings = world_shardings(self.kernel.state, self.mesh)
 
             def body(_, st):
@@ -181,13 +184,12 @@ class ShardedKernel:
                 return st2
 
             self._jit_run = jax.jit(
-                lambda st: jax.lax.fori_loop(0, key, body, st),
-                in_shardings=(shardings,),
+                lambda st, k: jax.lax.fori_loop(0, k, body, st),
+                in_shardings=(shardings, None),
                 out_shardings=shardings,
                 donate_argnums=0,
             )
-            self._jit_run_n = key
-        self.kernel.state = self._jit_run(self.kernel.state)
+        self.kernel.state = self._jit_run(self.kernel.state, jnp.int32(key))
         self.kernel.tick_count += key
 
 

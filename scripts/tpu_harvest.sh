@@ -1,0 +1,90 @@
+#!/bin/bash
+# Round-5 chip-time harvester: the axon TPU tunnel comes and goes (it was
+# up 01:01-01:09 UTC on 2026-07-31, long enough for one 100k capture,
+# then died mid-1M).  This loop probes every ~4 min and, the moment the
+# chip answers, burns down the capture queue below in priority order.
+# Each item is stamped in $STAMPS so a restart never repeats finished
+# work.  Only ONE process may hold the TPU: while an item runs, the loop
+# is that process.
+#
+# Usage: nohup bash scripts/tpu_harvest.sh >/tmp/harvest.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+STAMPS=/tmp/tpu_harvest_stamps
+mkdir -p "$STAMPS" bench_runs
+
+probe() {
+  timeout 110 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'; import jax.numpy as jnp; print(jax.jit(lambda x:x+1)(jnp.zeros(4))[0])" >/dev/null 2>&1
+}
+
+# run <name> <timeout_s> <cmd...>  — runs once, stamps on success (a JSON
+# line in the output counts as success for bench items).
+run_item() {
+  local name=$1 tmo=$2; shift 2
+  [ -e "$STAMPS/$name" ] && return 0
+  echo "[$(date -u +%H:%M:%S)] START $name"
+  timeout "$tmo" "$@" > "/tmp/harvest_$name.out" 2>&1
+  local rc=$?
+  if [ $rc -eq 0 ] && grep -q '"metric"\|"profile"\|PROBE_DONE' "/tmp/harvest_$name.out"; then
+    touch "$STAMPS/$name"
+    echo "[$(date -u +%H:%M:%S)] DONE $name"
+    return 0
+  fi
+  echo "[$(date -u +%H:%M:%S)] FAIL $name rc=$rc (tail):"
+  tail -2 "/tmp/harvest_$name.out"
+  return 1
+}
+
+save_json() { # save_json <name> <dest>  — extract last JSON line
+  grep -o '^{.*}$' "/tmp/harvest_$1.out" | tail -1 > "$2" && echo "saved $2"
+}
+
+while :; do
+  if ! probe; then
+    echo "[$(date -u +%H:%M:%S)] tunnel down"
+    sleep 230
+    continue
+  fi
+  echo "[$(date -u +%H:%M:%S)] tunnel UP — harvesting"
+
+  # 1. honest 100k re-capture (new reconcile-free windowed sampler)
+  run_item b100k 900 python -u bench.py --entities 100000 --ticks 90 --platform tpu \
+    && save_json b100k bench_runs/r05_tpu_100k_v2.json
+
+  # 2. the headline: 1M fused tick (single-compile bench now)
+  run_item b1m 1800 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
+    && save_json b1m bench_runs/r05_tpu_1m.json
+
+  # 3. per-phase attribution at 1M (where do the 120 ms go)
+  run_item prof1m 1800 python -u scripts/profile_tick.py --entities 1000000 --iters 5 \
+    && grep -o '^{.*}$' /tmp/harvest_prof1m.out | tail -1 > bench_runs/r05_profile_1m.json
+
+  # 4. radix-sort A/B at 1M (docs/ROOFLINE.md prime suspect)
+  run_item b1m_radix 1800 env NF_RADIX=1 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
+    && save_json b1m_radix bench_runs/r05_tpu_1m_radix.json
+
+  # 5. Pallas fused fold A/B at 1M
+  run_item b1m_pallas 1800 env NF_PALLAS=1 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
+    && save_json b1m_pallas bench_runs/r05_tpu_1m_pallas.json
+
+  # 6. served path on chip: tick + diff flush + interest fan-out, 500 sessions
+  run_item serve100k 1800 python -u bench.py --entities 100000 --ticks 30 --served \
+      --sessions 500 --interest-radius 8.0 --platform tpu \
+    && save_json serve100k bench_runs/r05_tpu_served_100k_interest.json
+
+  # 7. served path, group-broadcast mode (reference-parity fan-out)
+  run_item serve100k_bcast 1800 python -u bench.py --entities 100000 --ticks 30 --served \
+      --sessions 500 --platform tpu \
+    && save_json serve100k_bcast bench_runs/r05_tpu_served_100k.json
+
+  # 8. 250k rung (scaling point between the two captures)
+  run_item b250k 1200 python -u bench.py --entities 250000 --ticks 90 --platform tpu \
+    && save_json b250k bench_runs/r05_tpu_250k.json
+
+  n_done=$(ls "$STAMPS" | wc -l)
+  if [ "$n_done" -ge 8 ]; then
+    echo "[$(date -u +%H:%M:%S)] queue drained — exiting"
+    exit 0
+  fi
+  sleep 20
+done

@@ -379,3 +379,121 @@ def test_gm_modify_property_sets_named_property(rig):
          ReqCommand(command_id=0, command_str_value=b"HP",
                     command_value_int=55))
     assert int(k.get_property(g, "HP")) == 55
+
+
+def test_pvp_room_mode_is_the_pairs_not_the_requesters(rig):
+    """A pair formed by window-widening during ANOTHER mode's request
+    must be labeled with the PAIR's queue mode (review finding), and an
+    explicit score=0 must queue at 0, not fall back to Level."""
+    from noahgameframe_tpu.net.wire import AckPVPApplyMatch, ReqPVPApplyMatch
+
+    world, role, seat, send, acks = rig
+    a_ident, a = seat(1, "wa")
+    b_ident, b = seat(2, "wb")
+    c_ident, c = seat(3, "wc")
+    pvp = world.pvp
+    pvp.window = 10
+    send(a_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=2, score=100))
+    send(b_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=2, score=150))
+    assert not acks(101, MsgID.ACK_PVP_APPLY_MATCH)  # gap 50 > window 10
+    # both tickets have been waiting; widening covers the gap now
+    for t in pvp.queue:
+        t.queued_at -= 10.0  # 10 s * widen_per_s 50 = +500 window
+    send(c_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=1, score=100000))
+    got_a = acks(101, MsgID.ACK_PVP_APPLY_MATCH)
+    assert got_a  # a+b paired during c's request
+    _, ack = unwrap(got_a[-1], AckPVPApplyMatch)
+    assert ack.xRoomInfo.nPVPMode == 2  # the pair's mode, not c's 1
+    # explicit zero rating queues at 0 (not Level)
+    pvp.leave_queue(c)
+    send(c_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=3, score=0))
+    assert [t.score for t in pvp.queue if t.player == c] == [0]
+
+
+def test_pvp_despawn_cleans_queue_and_rooms(rig):
+    """Disconnect hygiene (review finding): a despawned player's ticket
+    leaves the queue and their pending rooms are dropped."""
+    from noahgameframe_tpu.net.wire import AckPVPApplyMatch, ReqPVPApplyMatch
+
+    world, role, seat, send, acks = rig
+    a_ident, a = seat(1, "da")
+    b_ident, b = seat(2, "db")
+    pvp = world.pvp
+    send(a_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=1, score=100))
+    assert any(t.player == a for t in pvp.queue)
+    role._despawn(role.sessions[ident_key(a_ident)])
+    assert not any(t.player == a for t in pvp.queue)  # ticket gone
+    # matched room leaks: pair, then one side despawns before ectype
+    a2_ident, a2 = seat(3, "da2")
+    send(a2_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=1, score=100))
+    send(b_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=1, score=110))
+    assert role._pvp_rooms  # room pending
+    role._despawn(role.sessions[ident_key(b_ident)])
+    assert not role._pvp_rooms  # dropped with the fighter
+
+
+def test_pvp_ectype_ack_self_id_is_per_recipient(rig):
+    """Each fighter's ACK_CREATE_PVP_ECTYPE carries THEIR ident as
+    self_id (review finding: both used to get the requester's)."""
+    from noahgameframe_tpu.net.wire import (
+        AckCreatePVPEctype,
+        AckPVPApplyMatch,
+        ReqCreatePVPEctype,
+        ReqPVPApplyMatch,
+    )
+
+    world, role, seat, send, acks = rig
+    a_ident, a = seat(1, "ea")
+    b_ident, b = seat(2, "eb")
+    send(a_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=1, score=100))
+    send(b_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=1, score=100))
+    _, ack = unwrap(acks(101, MsgID.ACK_PVP_APPLY_MATCH)[-1], AckPVPApplyMatch)
+    send(a_ident, MsgID.REQ_CREATE_PVP_ECTYPE,
+         ReqCreatePVPEctype(xRoomInfo=ack.xRoomInfo))
+    from noahgameframe_tpu.net.roles.game import guid_ident
+
+    for conn, g in ((101, a), (102, b)):
+        _, e = unwrap(acks(conn, MsgID.ACK_CREATE_PVP_ECTYPE)[-1],
+                      AckCreatePVPEctype)
+        want = guid_ident(g)
+        assert (e.self_id.svrid, e.self_id.index) == (want.svrid, want.index)
+
+
+def test_pvp_survivor_notified_and_reapply_switches_mode(rig):
+    """When a matched fighter despawns, the survivor hears nResult=0
+    (review finding: silent stuck room); re-applying while queued
+    switches the ticket to the new mode/score (review finding: silent
+    drop)."""
+    from noahgameframe_tpu.net.wire import AckPVPApplyMatch, ReqPVPApplyMatch
+
+    world, role, seat, send, acks = rig
+    a_ident, a = seat(1, "sa")
+    b_ident, b = seat(2, "sb")
+    send(a_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=1, score=100))
+    send(b_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=1, score=110))
+    assert role._pvp_rooms  # matched, room pending
+    n_before = len(acks(101, MsgID.ACK_PVP_APPLY_MATCH))
+    role._despawn(role.sessions[ident_key(b_ident)])
+    got = acks(101, MsgID.ACK_PVP_APPLY_MATCH)
+    assert len(got) == n_before + 1  # survivor notified
+    _, cancel = unwrap(got[-1], AckPVPApplyMatch)
+    assert cancel.nResult == 0  # cancelled, re-apply needed
+
+    # re-apply switches: queue once in mode 1, again in mode 2
+    send(a_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=1, score=50))
+    send(a_ident, MsgID.REQ_PVP_APPLY_MATCH,
+         ReqPVPApplyMatch(nPVPMode=2, score=70))
+    tickets = [t for t in world.pvp.queue if t.player == a]
+    assert [(t.mode, t.score) for t in tickets] == [(2, 70)]
