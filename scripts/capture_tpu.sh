@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-5 on-chip capture chain.  The axon tunnel is flaky (died mid-round-3,
+# whole round-4, and flaps within round 5): probe cheaply in a loop, and the
+# moment a dispatch succeeds run the whole capture ladder, writing each
+# artifact as soon as it exists so a mid-chain tunnel death loses only the
+# remaining steps.  Usage: scripts/capture_tpu.sh [once]
+set -u
+cd "$(dirname "$0")/.."
+OUT=bench_runs
+LOG=/tmp/capture_tpu.log
+export NF_COMPILE_CACHE=/tmp/nf_xla_cache
+
+probe() {
+  timeout 75 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+x = jax.jit(lambda x: x * 2)(jnp.ones(128))
+x.block_until_ready()
+assert jax.devices()[0].platform == "tpu"
+EOF
+}
+
+run_one() {  # run_one <outfile> <timeout_s> [bench args...]
+  local out="$1" tmo="$2"; shift 2
+  echo "$(date -u +%H:%M:%S) start $out: bench.py $*" >>"$LOG"
+  if timeout "$tmo" python bench.py --platform tpu "$@" >"/tmp/cap.$$" 2>>"$LOG"; then
+    if [ -s "/tmp/cap.$$" ] && python -c "import json,sys; json.load(open('/tmp/cap.$$'))" 2>/dev/null; then
+      mv "/tmp/cap.$$" "$OUT/$out"
+      echo "$(date -u +%H:%M:%S) DONE $out" >>"$LOG"
+      return 0
+    fi
+  fi
+  rm -f "/tmp/cap.$$"
+  echo "$(date -u +%H:%M:%S) FAILED/timeout $out" >>"$LOG"
+  return 1
+}
+
+chain() {
+  # Re-capture 100k with the fixed (reconcile-free) windowed sampler.
+  run_one r05_tpu_100k_fixed.json 900 --entities 100000 --ticks 60 --lat-budget-s 10 || return 1
+  # The headline: 1M, round-4/5 geometry, first time on chip.
+  run_one r05_tpu_1m.json 1500 --entities 1000000 --ticks 90 --lat-budget-s 25 || return 1
+  # A/B the radix-binning sort replacement at 1M (ROOFLINE.md prime suspect).
+  NF_RADIX=1 run_one r05_tpu_1m_radix.json 1500 --entities 1000000 --ticks 90 --lat-budget-s 25
+  # A/B the Pallas fold at 100k first (cheap validity check), then 1M.
+  NF_PALLAS=1 run_one r05_tpu_100k_pallas.json 900 --entities 100000 --ticks 60 --lat-budget-s 10
+  NF_PALLAS=1 run_one r05_tpu_1m_pallas.json 1500 --entities 1000000 --ticks 90 --lat-budget-s 25
+  # Served path on chip (verdict item 8): tick + diff flush + interest fanout.
+  run_one r05_tpu_served_100k.json 900 --served --entities 100000 --ticks 30 \
+    --sessions 500 --interest-radius 8
+  return 0
+}
+
+while :; do
+  if probe; then
+    echo "$(date -u +%H:%M:%S) tunnel UP - starting chain" >>"$LOG"
+    chain && { echo "$(date -u +%H:%M:%S) chain complete" >>"$LOG"; exit 0; }
+    echo "$(date -u +%H:%M:%S) chain incomplete; re-probing" >>"$LOG"
+  else
+    echo "$(date -u +%H:%M:%S) tunnel down" >>"$LOG"
+  fi
+  [ "${1:-}" = once ] && exit 1
+  sleep 120
+done
