@@ -1,0 +1,247 @@
+"""Per-pass on-chip timing for the fused world tick.
+
+docs/ROOFLINE.md puts the measured 1M tick ~25-30x above its bandwidth
+roofline and names the global sort as prime suspect, the table-build
+scatter grain second.  This script arbitrates: it times each pass of the
+combat pipeline SEPARATELY on the live backend (full tick, XLA argsort,
+radix argsort, pair-table build, stencil fold XLA/Pallas, payload
+scatter, pull gather) and prints one JSON object, ready for
+`bench_runs/`.
+
+RTT discipline: each timed region issues `reps` async dispatches and
+blocks ONCE at the end, so per-pass tunnel RTT amortizes to RTT/reps.
+
+Usage: python scripts/profile_passes.py [--entities 1000000] [--reps 20]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--entities", type=int, default=1_000_000)
+    ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--platform", choices=("tpu", "cpu"), default="tpu",
+                    help="cpu = smoke-test the harness off-chip (the "
+                         "sitecustomize axon hook overrides JAX_PLATFORMS, "
+                         "so this must force it post-import)")
+    args = ap.parse_args()
+
+    from noahgameframe_tpu.utils.platform import force_cpu, init_compile_cache
+
+    if args.platform == "cpu":
+        force_cpu()
+    init_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from noahgameframe_tpu.game import build_benchmark_world
+    from noahgameframe_tpu.ops.aoi import cell_of
+    from noahgameframe_tpu.ops import stencil
+    from noahgameframe_tpu.ops.stencil import (
+        _bits_for,
+        _radix_argsort,
+        build_cell_table_pair,
+        pull,
+        stencil_fold,
+    )
+
+    n = args.entities
+    reps = args.reps
+    world = build_benchmark_world(n, combat=True, seed=42)
+    k = world.kernel
+    combat = world.combat
+    spec = k.store.spec("NPC")
+
+    dev = jax.devices()[0]
+    out: dict = {
+        "metric": "pass_ms",
+        "entities": n,
+        "reps": reps,
+        "device": str(dev),
+        "platform": dev.platform,
+        "passes": {},
+    }
+
+    def timed(name, fn, *a):
+        """Median-free single measurement: warmup compile, then `reps`
+        queued dispatches with one terminal block (RTT/reps pollution)."""
+        try:
+            r = fn(*a)
+            jax.block_until_ready(r)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                r = fn(*a)
+            jax.block_until_ready(r)
+            ms = 1000 * (time.perf_counter() - t0) / reps
+            out["passes"][name] = round(ms, 3)
+            print(f"# {name}: {ms:.3f} ms", file=sys.stderr, flush=True)
+        except Exception as e:  # noqa: BLE001 — record and keep going
+            out["passes"][name] = f"ERROR {type(e).__name__}: {e}"
+            print(f"# {name}: FAILED {e}", file=sys.stderr, flush=True)
+
+    # -- the whole fused tick (1 tick per dispatch) ---------------------------
+    k.run_device(1)  # compile + host reconcile once
+    def tick():
+        k.run_device(1, reconcile=False)
+        return k.state.classes["NPC"].i32
+    timed("full_tick", tick)
+
+    # -- geometry shared with CombatModule -----------------------------------
+    # (read the class state AFTER the tick timing: the fused step donates
+    # its input buffers, so references captured earlier are deleted)
+    cs = k.state.classes["NPC"]
+    pos = cs.vec[:, spec.slot("Position").col, :2]
+    alive = cs.alive
+    cap = alive.shape[0]  # bank capacity (pow2) >= n live entities
+    cell_size, width = combat.cell_size, combat.width
+    bucket = combat.resolved_bucket(cap)
+    att_bucket = combat.resolved_att_bucket(cap)
+    n_cells = width * width
+    out["geometry"] = {
+        "width": width, "cell_size": cell_size,
+        "bucket": bucket, "att_bucket": att_bucket,
+    }
+
+    key = jnp.where(alive, cell_of(pos, cell_size, width), n_cells)
+    key = jax.block_until_ready(jax.jit(lambda x: x)(key))
+
+    timed("argsort_xla", jax.jit(jnp.argsort), key)
+    bits = _bits_for(n_cells)
+    timed("argsort_radix", jax.jit(lambda kk: _radix_argsort(kk, bits)), key)
+
+    # -- pair-table build (argsort + rank + scatter), as combat runs it -------
+    f32 = jnp.float32
+    camp_f = cs.i32[:, spec.slot("Camp").col].astype(f32)
+    scene_f = cs.i32[:, spec.slot("SceneID").col].astype(f32)
+    group_f = cs.i32[:, spec.slot("GroupID").col].astype(f32)
+    rows_f = jnp.arange(cap, dtype=f32)
+    atk_f = cs.i32[:, spec.slot("ATK_VALUE").col].astype(f32)
+    # attacker mask at the staggered duty the bench runs with
+    interval = max(1, k.schedule.ticks_of(combat.attack_period_s))
+    attacking = alive & ((jnp.arange(cap) % interval) == 0)
+    vic_feats = jnp.stack([pos[:, 0], pos[:, 1], camp_f, scene_f, group_f], -1)
+    att_feats = jnp.stack(
+        [pos[:, 0], pos[:, 1], atk_f, camp_f, scene_f, group_f, rows_f], -1
+    )
+
+    # CellTable carries static geometry ints — passing one through jit
+    # would trace them and break grid_view's reshape, so the jitted
+    # pieces take raw arrays and rebuild tables against closed-over
+    # static geometry.
+    from noahgameframe_tpu.ops.stencil import CellTable
+
+    def mk_vic(payload, slot_of):
+        return CellTable(payload, slot_of, jnp.int32(0), width, cell_size, bucket)
+
+    def mk_att(payload, slot_of):
+        return CellTable(payload, slot_of, jnp.int32(0), width, cell_size,
+                         att_bucket)
+
+    build = jax.jit(
+        lambda p, al, vf, am, af: build_cell_table_pair(
+            p, al, vf, am, af, cell_size, width, bucket, att_bucket
+        )
+    )
+    timed("build_pair_tables", build, pos, alive, vic_feats, attacking, att_feats)
+    vic_table, att_table = jax.block_until_ready(
+        build(pos, alive, vic_feats, attacking, att_feats)
+    )
+
+    # -- payload scatter / pull gather in isolation ---------------------------
+    dump = n_cells * bucket
+    occ = jnp.concatenate([vic_feats, jnp.ones((cap, 1), f32)], -1)
+    timed(
+        "payload_scatter",
+        jax.jit(
+            lambda so, ft: jnp.zeros((dump + 1, ft.shape[-1]), f32).at[so].set(ft)
+        ),
+        vic_table.slot_of, occ,
+    )
+    slot_res = jnp.zeros((width, width, bucket, 2), jnp.int32)
+    timed(
+        "pull_gather",
+        jax.jit(lambda so, r: pull(mk_vic(vic_table.payload, so), r,
+                                   fill=(0, -1))),
+        vic_table.slot_of, slot_res,
+    )
+
+    # -- the stencil fold, XLA and Pallas -------------------------------------
+    r2 = combat.radius * combat.radius
+
+    def fold_xla(vt, at):
+        v = vt.grid_view()
+        vx, vy = v[..., 0], v[..., 1]
+        vcamp, vscene, vgroup = v[..., 2], v[..., 3], v[..., 4]
+        idt = jnp.int32
+
+        def fold(acc, cand):
+            inc, besta, bestr = acc
+            cx = cand[:, :, None, :, 0]
+            cy = cand[:, :, None, :, 1]
+            ca = cand[:, :, None, :, 2]
+            cc = cand[:, :, None, :, 3]
+            cscene = cand[:, :, None, :, 4]
+            cgroup = cand[:, :, None, :, 5]
+            cr = cand[:, :, None, :, 6]
+            dx = vx[..., None] - cx
+            dy = vy[..., None] - cy
+            ok = (
+                (dx * dx + dy * dy <= r2)
+                & (ca != 0)
+                & (cc != vcamp[..., None])
+                & (cscene == vscene[..., None])
+                & (cgroup == vgroup[..., None])
+            )
+            inc = inc + jnp.sum(jnp.where(ok, ca, 0.0), -1).astype(idt)
+            sa = jnp.where(ok, ca, -1.0)
+            m = jnp.max(sa, -1)
+            first = jnp.min(jnp.where(sa >= m[..., None], cr, jnp.inf), -1)
+            better = m > besta
+            return (
+                inc,
+                jnp.where(better, m, besta),
+                jnp.where(better, first.astype(idt), bestr),
+            )
+
+        zeros = jnp.zeros(v.shape[:3], idt)
+        return stencil_fold(at, fold, (zeros, zeros.astype(f32) - 1, zeros - 1))
+
+    timed(
+        "fold_xla",
+        jax.jit(lambda vp, vs, ap, as_: fold_xla(mk_vic(vp, vs), mk_att(ap, as_))),
+        vic_table.payload, vic_table.slot_of,
+        att_table.payload, att_table.slot_of,
+    )
+
+    try:
+        from noahgameframe_tpu.ops.stencil_pallas import combat_fold_pallas
+
+        interp = jax.default_backend() not in ("tpu", "axon")
+        timed(
+            "fold_pallas" + ("_interpret" if interp else ""),
+            jax.jit(
+                lambda vp, vs, ap, as_: combat_fold_pallas(
+                    mk_vic(vp, vs), mk_att(ap, as_), combat.radius,
+                    interpret=interp,
+                )
+            ),
+            vic_table.payload, vic_table.slot_of,
+            att_table.payload, att_table.slot_of,
+        )
+    except Exception as e:  # noqa: BLE001
+        out["passes"]["fold_pallas"] = f"ERROR {type(e).__name__}: {e}"
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

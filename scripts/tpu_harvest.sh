@@ -25,7 +25,11 @@ run_item() {
   echo "[$(date -u +%H:%M:%S)] START $name"
   timeout "$tmo" "$@" > "/tmp/harvest_$name.out" 2>&1
   local rc=$?
-  if [ $rc -eq 0 ] && grep -q '"metric"\|"profile"\|PROBE_DONE' "/tmp/harvest_$name.out"; then
+  # success = exit 0 + a JSON/marker line that is NOT an error payload
+  # (bench.py catches exceptions and emits {"metric":..., "error":...}
+  # with exit 0 — stamping that would archive a dead-tunnel artifact)
+  if [ $rc -eq 0 ] && grep -q '"metric"\|"profile"\|"passes"\|PROBE_DONE' "/tmp/harvest_$name.out" \
+     && ! grep -o '^{.*}$' "/tmp/harvest_$name.out" | tail -1 | grep -q '"error"'; then
     touch "$STAMPS/$name"
     echo "[$(date -u +%H:%M:%S)] DONE $name"
     return 0
@@ -59,6 +63,11 @@ while :; do
   run_item prof1m 1800 python -u scripts/profile_tick.py --entities 1000000 --iters 5 \
     && grep -o '^{.*}$' /tmp/harvest_prof1m.out | tail -1 > bench_runs/r05_profile_1m.json
 
+  # 3b. isolated per-pass timings at 1M (sort vs build vs fold vs scatter —
+  #     arbitrates docs/ROOFLINE.md's suspects independent of phase nesting)
+  run_item passes1m 1800 python -u scripts/profile_passes.py --entities 1000000 --reps 10 \
+    && grep -o '^{.*}$' /tmp/harvest_passes1m.out | tail -1 > bench_runs/r05_passes_1m.json
+
   # 4. radix-sort A/B at 1M (docs/ROOFLINE.md prime suspect)
   run_item b1m_radix 1800 env NF_RADIX=1 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
     && save_json b1m_radix bench_runs/r05_tpu_1m_radix.json
@@ -82,7 +91,7 @@ while :; do
     && save_json b250k bench_runs/r05_tpu_250k.json
 
   n_done=$(ls "$STAMPS" | wc -l)
-  if [ "$n_done" -ge 8 ]; then
+  if [ "$n_done" -ge 9 ]; then
     echo "[$(date -u +%H:%M:%S)] queue drained — exiting"
     exit 0
   fi
