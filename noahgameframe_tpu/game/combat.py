@@ -40,6 +40,65 @@ from .defines import GameEvent
 ATTACK_TIMER = "Attack"
 
 
+def combat_fold_xla(vic_table, att_table, radius):
+    """The XLA stencil fold over the split victim/attacker cell tables:
+    nine shifted candidate blocks against the resident victim grid, with
+    [Kv, Ka] pairwise masked reductions fused by XLA onto the VPU.
+
+    Same contract as ops.stencil_pallas.combat_fold_pallas — returns
+    (inc [H, W, Kv] int32 damage totals, bestr [H, W, Kv] int32 row id
+    of the strongest in-range attacker, -1 = none) — and the single
+    source of truth for the fold's feature-column layout and tie-break
+    semantics (scripts/profile_passes.py times this exact function).
+
+    Victim payload columns: x, y, camp, scene, group (+occupancy).
+    Attacker payload columns: x, y, eff_atk, camp, scene, group, row.
+    No self-exclusion compare: self always shares its own camp, so the
+    no-friendly-fire mask rules self out of every pair."""
+    v = vic_table.grid_view()
+    vx, vy = v[..., 0], v[..., 1]
+    vcamp, vscene, vgroup = v[..., 2], v[..., 3], v[..., 4]
+    r2 = float(radius) * float(radius)
+    idt = jnp.int32
+    f32 = jnp.float32
+
+    def fold(acc, cand):
+        inc, besta, bestr = acc
+        cx = cand[:, :, None, :, 0]
+        cy = cand[:, :, None, :, 1]
+        ca = cand[:, :, None, :, 2]
+        cc = cand[:, :, None, :, 3]
+        cscene = cand[:, :, None, :, 4]
+        cgroup = cand[:, :, None, :, 5]
+        cr = cand[:, :, None, :, 6]
+        dx = vx[..., None] - cx
+        dy = vy[..., None] - cy
+        ok = (
+            (dx * dx + dy * dy <= r2)
+            & (ca != 0)  # a real attacker (empty slots carry 0)
+            & (cc != vcamp[..., None])  # no friendly fire (also self)
+            & (cscene == vscene[..., None])  # same scene...
+            & (cgroup == vgroup[..., None])  # ...and group
+        )
+        inc = inc + jnp.sum(jnp.where(ok, ca, 0.0), axis=-1).astype(idt)
+        # strongest attacker; ties resolve to the first candidate in
+        # (stencil, slot) order — slots hold ascending rows, so the
+        # within-shift tie-break is min-row
+        sa = jnp.where(ok, ca, -1.0)
+        m = jnp.max(sa, axis=-1)
+        first = jnp.min(jnp.where(sa >= m[..., None], cr, jnp.inf), axis=-1)
+        better = m > besta
+        besta = jnp.where(better, m, besta)
+        bestr = jnp.where(better, first.astype(idt), bestr)
+        return inc, besta, bestr
+
+    zeros = jnp.zeros(v.shape[:3], idt)
+    inc, _besta, bestr = stencil_fold(
+        att_table, fold, (zeros, jnp.zeros(v.shape[:3], f32) - 1.0, zeros - 1)
+    )
+    return inc, bestr
+
+
 class CombatModule(Module):
     """Batched AoE combat + death/respawn for one fighter class."""
 
@@ -297,50 +356,7 @@ class CombatModule(Module):
                 interpret=jax.default_backend() not in ("tpu", "axon"),
             )
         else:
-            v = vic_table.grid_view()
-            vx, vy = v[..., 0], v[..., 1]
-            vcamp, vscene, vgroup = v[..., 2], v[..., 3], v[..., 4]
-            r2 = self.radius * self.radius
-            idt = jnp.int32
-
-            def fold(acc, cand):
-                inc, besta, bestr = acc
-                cx = cand[:, :, None, :, 0]
-                cy = cand[:, :, None, :, 1]
-                ca = cand[:, :, None, :, 2]
-                cc = cand[:, :, None, :, 3]
-                cscene = cand[:, :, None, :, 4]
-                cgroup = cand[:, :, None, :, 5]
-                cr = cand[:, :, None, :, 6]
-                dx = vx[..., None] - cx
-                dy = vy[..., None] - cy
-                ok = (
-                    (dx * dx + dy * dy <= r2)
-                    & (ca != 0)  # a real attacker (empty slots carry 0)
-                    & (cc != vcamp[..., None])  # no friendly fire (also self)
-                    & (cscene == vscene[..., None])  # same scene...
-                    & (cgroup == vgroup[..., None])  # ...and group
-                )
-                inc = inc + jnp.sum(jnp.where(ok, ca, 0.0), axis=-1).astype(idt)
-                # strongest attacker; ties resolve to the first candidate
-                # in (stencil, slot) order — slots hold ascending rows,
-                # so the within-shift tie-break is min-row
-                sa = jnp.where(ok, ca, -1.0)
-                m = jnp.max(sa, axis=-1)
-                first = jnp.min(
-                    jnp.where(sa >= m[..., None], cr, jnp.inf), axis=-1
-                )
-                better = m > besta
-                besta = jnp.where(better, m, besta)
-                bestr = jnp.where(better, first.astype(idt), bestr)
-                return inc, besta, bestr
-
-            zeros = jnp.zeros(v.shape[:3], idt)
-            inc, _besta, bestr = stencil_fold(
-                att_table,
-                fold,
-                (zeros, jnp.zeros(v.shape[:3], f32) - 1.0, zeros - 1),
-            )
+            inc, bestr = combat_fold_xla(vic_table, att_table, self.radius)
         if self.emit_events:
             # runtime overflow signal: the duty-sized attacker bucket is
             # baked into the traced tick, so arming patterns that

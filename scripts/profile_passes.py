@@ -45,13 +45,11 @@ def main() -> None:
 
     from noahgameframe_tpu.game import build_benchmark_world
     from noahgameframe_tpu.ops.aoi import cell_of
-    from noahgameframe_tpu.ops import stencil
     from noahgameframe_tpu.ops.stencil import (
         _bits_for,
         _radix_argsort,
         build_cell_table_pair,
         pull,
-        stencil_fold,
     )
 
     n = args.entities
@@ -174,46 +172,12 @@ def main() -> None:
         vic_table.slot_of, slot_res,
     )
 
-    # -- the stencil fold, XLA and Pallas -------------------------------------
-    r2 = combat.radius * combat.radius
+    # -- the stencil fold, XLA and Pallas (the production fold functions —
+    # combat_fold_xla is the single source of truth for layout/semantics) ----
+    from noahgameframe_tpu.game.combat import combat_fold_xla
 
     def fold_xla(vt, at):
-        v = vt.grid_view()
-        vx, vy = v[..., 0], v[..., 1]
-        vcamp, vscene, vgroup = v[..., 2], v[..., 3], v[..., 4]
-        idt = jnp.int32
-
-        def fold(acc, cand):
-            inc, besta, bestr = acc
-            cx = cand[:, :, None, :, 0]
-            cy = cand[:, :, None, :, 1]
-            ca = cand[:, :, None, :, 2]
-            cc = cand[:, :, None, :, 3]
-            cscene = cand[:, :, None, :, 4]
-            cgroup = cand[:, :, None, :, 5]
-            cr = cand[:, :, None, :, 6]
-            dx = vx[..., None] - cx
-            dy = vy[..., None] - cy
-            ok = (
-                (dx * dx + dy * dy <= r2)
-                & (ca != 0)
-                & (cc != vcamp[..., None])
-                & (cscene == vscene[..., None])
-                & (cgroup == vgroup[..., None])
-            )
-            inc = inc + jnp.sum(jnp.where(ok, ca, 0.0), -1).astype(idt)
-            sa = jnp.where(ok, ca, -1.0)
-            m = jnp.max(sa, -1)
-            first = jnp.min(jnp.where(sa >= m[..., None], cr, jnp.inf), -1)
-            better = m > besta
-            return (
-                inc,
-                jnp.where(better, m, besta),
-                jnp.where(better, first.astype(idt), bestr),
-            )
-
-        zeros = jnp.zeros(v.shape[:3], idt)
-        return stencil_fold(at, fold, (zeros, zeros.astype(f32) - 1, zeros - 1))
+        return combat_fold_xla(vt, at, combat.radius)
 
     timed(
         "fold_xla",

@@ -90,8 +90,15 @@ while :; do
   run_item b250k 1200 python -u bench.py --entities 250000 --ticks 90 --platform tpu \
     && save_json b250k bench_runs/r05_tpu_250k.json
 
+  # 9. BASELINE config 3 (500k, AOI under combat load) and config 2
+  #    (100k random-walk + regen, no combat) at their own shapes
+  run_item b500k 1500 python -u bench.py --entities 500000 --ticks 90 --platform tpu \
+    && save_json b500k bench_runs/r05_tpu_500k.json
+  run_item b100k_walk 900 python -u bench.py --entities 100000 --ticks 90 --no-combat --platform tpu \
+    && save_json b100k_walk bench_runs/r05_tpu_100k_nocombat.json
+
   n_done=$(ls "$STAMPS" | wc -l)
-  if [ "$n_done" -ge 9 ]; then
+  if [ "$n_done" -ge 11 ]; then
     echo "[$(date -u +%H:%M:%S)] queue drained — exiting"
     exit 0
   fi
