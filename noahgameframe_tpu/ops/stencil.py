@@ -104,32 +104,62 @@ def auto_bucket(
     return -(-k // align) * align
 
 
-def _radix_argsort(key: jnp.ndarray, n_bits: int) -> jnp.ndarray:
-    """Stable LSD binary-radix argsort for small non-negative int keys.
+def _radix_argsort(
+    key: jnp.ndarray, n_bits: int, bits_per_pass: int = 1
+) -> jnp.ndarray:
+    """Stable LSD radix argsort for small non-negative int keys.
 
     XLA's TPU `sort` is a comparison network with poor large-N
     efficiency; per docs/ROOFLINE.md it is the prime suspect for the
-    1M-tick gap.  This replaces it with `n_bits` stable partition
-    passes, each two cumsums + one unique-index scatter over [N] i32 —
-    bandwidth-bound streaming work (~20 x 30 MB at 1M) instead of
-    O(log^2 N) comparison stages.  Bit-identical to `jnp.argsort(key)`
-    (both stable).  Opt-in via NF_RADIX=1 until chip time ranks the two
-    (virtual-CPU timing cannot)."""
+    1M-tick gap.  This replaces it with ceil(n_bits / bits_per_pass)
+    stable partition passes — streaming cumsums plus two unique-index
+    scatters per pass over [N] i32 — instead of O(log^2 N) comparison
+    stages.  Bit-identical to `jnp.argsort(key)` (both stable).
+
+    bits_per_pass trades cumsum work for scatter count: the two
+    permutation scatters are the irregular (bandwidth-hostile) part of
+    a pass, so 2-3 bits per pass cuts them 2-3x while the added
+    per-digit cumsum planes ([N, 2^b] one-hot) stay cheap streaming
+    work.  Opt-in via NF_RADIX=<bits_per_pass> until chip time ranks
+    the variants against XLA's sort (virtual-CPU timing cannot)."""
     n = key.shape[0]
     order = jnp.arange(n, dtype=jnp.int32)
+    b = max(1, int(bits_per_pass))
+    n_digits = 1 << b
+    n_passes = -(-n_bits // b)
+    mask = n_digits - 1
 
-    def one_pass(i, kv):
-        k, o = kv
-        bit = (k >> i) & 1
-        zeros = jnp.cumsum(1 - bit)  # inclusive; stable within each half
-        ones = jnp.cumsum(bit)
-        pos = jnp.where(bit == 0, zeros - 1, zeros[-1] + ones - 1)
-        return (
-            jnp.zeros_like(k).at[pos].set(k),
-            jnp.zeros_like(o).at[pos].set(o),
-        )
+    if b == 1:
+        def one_pass(i, kv):
+            k, o = kv
+            bit = (k >> (i * 1)) & 1
+            zeros = jnp.cumsum(1 - bit)  # inclusive; stable in each half
+            ones = jnp.cumsum(bit)
+            pos = jnp.where(bit == 0, zeros - 1, zeros[-1] + ones - 1)
+            return (
+                jnp.zeros_like(k).at[pos].set(k),
+                jnp.zeros_like(o).at[pos].set(o),
+            )
+    else:
+        def one_pass(i, kv):
+            k, o = kv
+            digit = (k >> (i * b)) & mask
+            onehot = (
+                digit[:, None] == jnp.arange(n_digits, dtype=k.dtype)[None, :]
+            ).astype(jnp.int32)
+            incl = jnp.cumsum(onehot, axis=0)  # [N, D] running count per digit
+            totals = incl[-1]
+            base = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(totals)[:-1]]
+            )
+            rank = jnp.take_along_axis(incl, digit[:, None], axis=1)[:, 0]
+            pos = base[digit] + rank - 1
+            return (
+                jnp.zeros_like(k).at[pos].set(k),
+                jnp.zeros_like(o).at[pos].set(o),
+            )
 
-    _, order = jax.lax.fori_loop(0, n_bits, one_pass, (key, order))
+    _, order = jax.lax.fori_loop(0, n_passes, one_pass, (key, order))
     return order
 
 
@@ -153,8 +183,11 @@ def _sorted_segments(pos, active, cell_size: float, width: int):
     n_cells = width * width
     cell = cell_of(pos, cell_size, width)
     key = jnp.where(active, cell, n_cells)
-    if os.environ.get("NF_RADIX", "") == "1":
-        order = _radix_argsort(key, _bits_for(n_cells))
+    radix = os.environ.get("NF_RADIX", "")
+    if radix.isdigit() and int(radix) > 0:
+        # NF_RADIX=<bits per pass>: 1 = binary partition passes,
+        # 2/3 = 4-way/8-way digits (fewer irregular scatters)
+        order = _radix_argsort(key, _bits_for(n_cells), int(radix))
     else:
         order = jnp.argsort(key)  # stable: preserves row order within a cell
     skey = key[order]

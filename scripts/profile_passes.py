@@ -114,7 +114,12 @@ def main() -> None:
 
     timed("argsort_xla", jax.jit(jnp.argsort), key)
     bits = _bits_for(n_cells)
-    timed("argsort_radix", jax.jit(lambda kk: _radix_argsort(kk, bits)), key)
+    for b in (1, 2, 3):  # binary / 4-way / 8-way digit variants
+        timed(
+            f"argsort_radix_b{b}",
+            jax.jit(lambda kk, b=b: _radix_argsort(kk, bits, b)),
+            key,
+        )
 
     # -- pair-table build (argsort + rank + scatter), as combat runs it -------
     f32 = jnp.float32

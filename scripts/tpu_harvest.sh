@@ -72,6 +72,10 @@ while :; do
   run_item b1m_radix 1800 env NF_RADIX=1 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
     && save_json b1m_radix bench_runs/r05_tpu_1m_radix.json
 
+  # 4b. 4-way-digit radix variant (half the irregular scatters of NF_RADIX=1)
+  run_item b1m_radix2 1800 env NF_RADIX=2 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
+    && save_json b1m_radix2 bench_runs/r05_tpu_1m_radix2.json
+
   # 5. Pallas fused fold A/B at 1M
   run_item b1m_pallas 1800 env NF_PALLAS=1 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
     && save_json b1m_pallas bench_runs/r05_tpu_1m_pallas.json
@@ -98,7 +102,7 @@ while :; do
     && save_json b100k_walk bench_runs/r05_tpu_100k_nocombat.json
 
   n_done=$(ls "$STAMPS" | wc -l)
-  if [ "$n_done" -ge 11 ]; then
+  if [ "$n_done" -ge 12 ]; then
     echo "[$(date -u +%H:%M:%S)] queue drained — exiting"
     exit 0
   fi

@@ -369,9 +369,14 @@ def test_radix_argsort_matches_stable_argsort():
     rng = np.random.default_rng(11)
     for n, hi in ((1, 2), (257, 9), (4096, 1024), (10_000, 156_026)):
         key = jnp.asarray(rng.integers(0, hi, n).astype(np.int32))
-        got = np.asarray(_radix_argsort(key, _bits_for(hi - 1)))
         want = np.asarray(jnp.argsort(key))
-        np.testing.assert_array_equal(got, want, err_msg=f"n={n} hi={hi}")
+        for bits_per_pass in (1, 2, 3):
+            got = np.asarray(
+                _radix_argsort(key, _bits_for(hi - 1), bits_per_pass)
+            )
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"n={n} hi={hi} b={bits_per_pass}"
+            )
 
 
 def test_cell_table_radix_parity(monkeypatch):
@@ -390,8 +395,13 @@ def test_cell_table_radix_parity(monkeypatch):
     feats = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
 
     t0 = build_cell_table(pos, active, feats, cell, width, bucket)
-    monkeypatch.setenv("NF_RADIX", "1")
-    t1 = build_cell_table(pos, active, feats, cell, width, bucket)
-    np.testing.assert_array_equal(np.asarray(t0.slot_of), np.asarray(t1.slot_of))
-    np.testing.assert_array_equal(np.asarray(t0.payload), np.asarray(t1.payload))
-    assert int(t0.dropped) == int(t1.dropped)
+    for bits in ("1", "2", "3"):
+        monkeypatch.setenv("NF_RADIX", bits)
+        t1 = build_cell_table(pos, active, feats, cell, width, bucket)
+        np.testing.assert_array_equal(
+            np.asarray(t0.slot_of), np.asarray(t1.slot_of)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(t0.payload), np.asarray(t1.payload)
+        )
+        assert int(t0.dropped) == int(t1.dropped)
