@@ -40,22 +40,12 @@ from .defines import GameEvent
 ATTACK_TIMER = "Attack"
 
 
-def combat_fold_xla(vic_table, att_table, radius):
-    """The XLA stencil fold over the split victim/attacker cell tables:
-    nine shifted candidate blocks against the resident victim grid, with
-    [Kv, Ka] pairwise masked reductions fused by XLA onto the VPU.
-
-    Same contract as ops.stencil_pallas.combat_fold_pallas — returns
-    (inc [H, W, Kv] int32 damage totals, bestr [H, W, Kv] int32 row id
-    of the strongest in-range attacker, -1 = none) — and the single
-    source of truth for the fold's feature-column layout and tie-break
-    semantics (scripts/profile_passes.py times this exact function).
-
-    Victim payload columns: x, y, camp, scene, group (+occupancy).
-    Attacker payload columns: x, y, eff_atk, camp, scene, group, row.
-    No self-exclusion compare: self always shares its own camp, so the
-    no-friendly-fire mask rules self out of every pair."""
-    v = vic_table.grid_view()
+def combat_fold_closure(v, radius):
+    """(fold, init) over a victim grid view v [H, W, Kv, F+1] — the
+    fold body shared by combat_fold_xla (square grids) and the spatial
+    slab shards (rectangular grids with real halo rows,
+    parallel/spatial.py), so mask semantics and tie-breaks cannot
+    drift between the single-chip and distributed paths."""
     vx, vy = v[..., 0], v[..., 1]
     vcamp, vscene, vgroup = v[..., 2], v[..., 3], v[..., 4]
     r2 = float(radius) * float(radius)
@@ -93,9 +83,27 @@ def combat_fold_xla(vic_table, att_table, radius):
         return inc, besta, bestr
 
     zeros = jnp.zeros(v.shape[:3], idt)
-    inc, _besta, bestr = stencil_fold(
-        att_table, fold, (zeros, jnp.zeros(v.shape[:3], f32) - 1.0, zeros - 1)
-    )
+    init = (zeros, jnp.zeros(v.shape[:3], f32) - 1.0, zeros - 1)
+    return fold, init
+
+
+def combat_fold_xla(vic_table, att_table, radius):
+    """The XLA stencil fold over the split victim/attacker cell tables:
+    nine shifted candidate blocks against the resident victim grid, with
+    [Kv, Ka] pairwise masked reductions fused by XLA onto the VPU.
+
+    Same contract as ops.stencil_pallas.combat_fold_pallas — returns
+    (inc [H, W, Kv] int32 damage totals, bestr [H, W, Kv] int32 row id
+    of the strongest in-range attacker, -1 = none) — and the single
+    source of truth for the fold's feature-column layout and tie-break
+    semantics (scripts/profile_passes.py times this exact function).
+
+    Victim payload columns: x, y, camp, scene, group (+occupancy).
+    Attacker payload columns: x, y, eff_atk, camp, scene, group, row.
+    No self-exclusion compare: self always shares its own camp, so the
+    no-friendly-fire mask rules self out of every pair."""
+    fold, init = combat_fold_closure(vic_table.grid_view(), radius)
+    inc, _besta, bestr = stencil_fold(att_table, fold, init)
     return inc, bestr
 
 

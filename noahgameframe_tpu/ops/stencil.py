@@ -73,10 +73,15 @@ class CellTable(NamedTuple):
     width: int
     cell_size: float
     bucket: int
+    # rectangular grids (spatial slab sharding): rows of the grid; -1
+    # means square (height == width).  Trailing default keeps the many
+    # existing 6-field positional constructions valid.
+    height: int = -1
 
     def grid_view(self) -> jnp.ndarray:
         """[H, W, K, F+1] dense view (dump slot excluded)."""
-        h = w = self.width
+        h = self.height if self.height > 0 else self.width
+        w = self.width
         k = self.bucket
         return self.payload[:-1].reshape(h, w, k, self.payload.shape[-1])
 
@@ -169,10 +174,15 @@ def _bits_for(n_cells: int) -> int:
     return max(1, int(n_cells).bit_length())
 
 
-def _sorted_segments(pos, active, cell_size: float, width: int):
+def _sorted_segments(pos, active, cell_size: float, width: int,
+                     cell=None, n_cells: int | None = None):
     """Shared build prefix: the ONE stable argsort by cell id plus
     per-element segment ranks.  Returns (n_cells, order, skey, seg_start,
-    rank) — everything both table builders derive slots from."""
+    rank) — everything both table builders derive slots from.
+
+    cell/n_cells: precomputed per-row cell ids over a caller-defined
+    (possibly rectangular) grid — the spatial slab shards pass local
+    slab-relative ids; default derives square-grid ids from pos."""
     import os
 
     n = pos.shape[0]
@@ -180,8 +190,11 @@ def _sorted_segments(pos, active, cell_size: float, width: int):
         # row ids (and other int-valued columns) ride in f32 payload
         # columns, exact only below 2^24 — refuse silent corruption
         raise ValueError(f"cell table capacity {n} >= 2^24 breaks f32 row ids")
-    n_cells = width * width
-    cell = cell_of(pos, cell_size, width)
+    if cell is None:
+        n_cells = width * width
+        cell = cell_of(pos, cell_size, width)
+    elif n_cells is None:
+        raise ValueError("precomputed cell ids need n_cells")
     key = jnp.where(active, cell, n_cells)
     radix = os.environ.get("NF_RADIX", "")
     if radix.isdigit() and int(radix) > 0:
@@ -203,7 +216,7 @@ def _sorted_segments(pos, active, cell_size: float, width: int):
 
 def _finish_table(
     features, active, n_cells: int, order, skey, rank,
-    cell_size: float, width: int, bucket: int,
+    cell_size: float, width: int, bucket: int, height: int = -1,
 ) -> CellTable:
     """Shared build suffix: slots from ranks, ONE deterministic scatter
     (unique slot indices), dump-slot zeroing, drop count."""
@@ -226,7 +239,7 @@ def _finish_table(
     # dump slot may have been written by any loser; force it empty
     payload = payload.at[dump].set(0.0)
     dropped = jnp.sum(active & (slot_of == dump), dtype=jnp.int32)
-    return CellTable(payload, slot_of, dropped, width, cell_size, bucket)
+    return CellTable(payload, slot_of, dropped, width, cell_size, bucket, height)
 
 
 def build_cell_table(
@@ -261,6 +274,8 @@ def build_cell_table_pair(
     width: int,
     bucket: int,
     sub_bucket: int,
+    cell: jnp.ndarray | None = None,
+    height: int = -1,
 ) -> Tuple[CellTable, CellTable]:
     """Build the full table AND a subset table from ONE argsort.
 
@@ -269,12 +284,18 @@ def build_cell_table_pair(
     `build_cell_table` calls — within a cell both tables hold rows in
     ascending order, and the subset ranks are the subset's own ordinal
     positions — but the second sort and its key gather are replaced by a
-    segmented cumsum over the shared sorted order."""
+    segmented cumsum over the shared sorted order.
+
+    cell/height: precomputed cell ids over a rectangular [height, width]
+    grid (spatial slab shards); default square grid derived from pos."""
+    n_rows = height if height > 0 else width
     n_cells, order, skey, seg_start, rank = _sorted_segments(
-        pos, active, cell_size, width
+        pos, active, cell_size, width, cell=cell,
+        n_cells=(n_rows * width if cell is not None else None),
     )
     full = _finish_table(
-        features, active, n_cells, order, skey, rank, cell_size, width, bucket
+        features, active, n_cells, order, skey, rank, cell_size, width,
+        bucket, height,
     )
     # subset ranks via segmented exclusive cumsum: ex is non-decreasing,
     # so "ex at my segment's head" is a cummax over heads — no gather.
@@ -286,7 +307,7 @@ def build_cell_table_pair(
     sub_rank = jnp.where(sub_sorted, ex - head_ex, n_cells * sub_bucket + 1)
     sub = _finish_table(
         sub_features, sub_mask, n_cells, order, skey, sub_rank,
-        cell_size, width, sub_bucket,
+        cell_size, width, sub_bucket, height,
     )
     return full, sub
 

@@ -14,6 +14,7 @@ from .multihost import (
     serve_dist,
 )
 from .shard import ShardedKernel, shard_rows_by_cell, world_shardings
+from .spatial import SpatialGeom, SpatialState, SpatialWorld
 
 __all__ = [
     "DistRendezvous",
@@ -23,6 +24,9 @@ __all__ = [
     "serve_dist",
     "SHARD_AXIS",
     "ShardedKernel",
+    "SpatialGeom",
+    "SpatialState",
+    "SpatialWorld",
     "make_mesh",
     "replicated",
     "row_sharding",

@@ -1,0 +1,376 @@
+"""Spatially-sharded combat core: slab partition, halo exchange, migration.
+
+The default sharded world (`parallel/shard.py`) shards the ENTITY axis
+and lets XLA partition the cell-table argsort — correct, but the
+partitioned sort is a global all-to-all every tick and was the round-3
+sharded-compile/latency hotspot.  This module is the TPU-first
+alternative the round-4 verdict asked to explore: partition SPACE, not
+rows.
+
+Design (scaling-book recipe: pick a mesh, keep collectives O(boundary)):
+
+- The [width x width] cell grid is cut into `n_shards` horizontal slabs
+  of `slab_h` cell rows; shard i owns slab i and the entities inside it.
+- Each tick, every shard builds its OWN cell table (argsort over
+  capacity/n_shards rows — the sort shrinks with the mesh instead of
+  becoming a distributed sort).
+- The 3x3 stencil fold needs attacker candidates from the one cell row
+  beyond each slab edge: shards exchange their edge attacker PLANES
+  ([1, W, K_att, F] — dense, fixed-size) with both neighbors via
+  `lax.ppermute`, then fold locally over [slab_h + 2] rows.  Bytes on
+  the wire per tick are O(W * K_att), independent of entity count.
+- Entities whose cell crossed a slab boundary MIGRATE: up to
+  `mig_budget` rows per direction per tick are packed, `ppermute`d to
+  the neighbor shard, and scattered into free bank slots — real
+  cross-shard migration (BASELINE config 5), with overflow counters
+  when the budget or the destination bank is full.  A row that could
+  not migrate stays home and simply misses combat that tick (counted,
+  like a cell-bucket overflow) and retries next tick.
+
+Damage semantics are bit-identical to the single-device engine: the
+fold body is game.combat.combat_fold_closure (shared, not copied), the
+attacker `row` payload column carries the GLOBAL entity gid, damage
+sums are exact int32 in f32 (< 2^24), and tie-breaks reduce over gid —
+so within migration/bucket budgets, spatial and single-device worlds
+produce identical HP trajectories (tests/test_spatial.py pins this).
+
+Reference contrast: NFCWorldNet_ServerModule.cpp:600-830 re-homes
+players between game servers through the World relay (serialize,
+destroy, recreate); here migration is two fixed-size collectives inside
+the jitted tick and visibility across the boundary is a dense halo, not
+a relay hop.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..game.combat import combat_fold_closure
+from ..ops.stencil import build_cell_table_pair, pull
+from .mesh import SHARD_AXIS, make_mesh
+
+
+class SpatialGeom(NamedTuple):
+    """Static geometry of the spatially-sharded world."""
+
+    extent: float          # world is [0, extent)^2
+    cell_size: float
+    width: int             # cells per axis; grid [width, width]
+    n_shards: int          # horizontal slabs; width % n_shards == 0
+    bucket: int            # victim slots per cell
+    att_bucket: int        # attacker slots per cell
+    radius: float          # AoE radius (<= cell_size)
+    mig_budget: int        # migrant rows per direction per shard per tick
+    speed: float = 0.5     # random-walk step per tick (< cell_size)
+    attack_period: int = 30  # a gid attacks every `attack_period` ticks
+
+    @property
+    def slab_h(self) -> int:
+        return self.width // self.n_shards
+
+
+class SpatialState(NamedTuple):
+    """Per-entity banks, leading axis = n_shards * bank_size, sharded
+    row-wise so shard i holds rows [i*bank : (i+1)*bank]."""
+
+    pos: jnp.ndarray     # [cap, 2] f32
+    hp: jnp.ndarray      # [cap] i32
+    atk: jnp.ndarray     # [cap] i32
+    camp: jnp.ndarray    # [cap] i32
+    gid: jnp.ndarray     # [cap] i32 — stable global id, rides migration
+    active: jnp.ndarray  # [cap] bool
+
+
+def _walk(pos, gid, tick, geom: SpatialGeom):
+    """Deterministic per-gid random walk — a pure function of (gid,
+    tick), so every shard placement computes the identical trajectory
+    (the parity tests rely on this)."""
+    h = (gid.astype(jnp.uint32) * jnp.uint32(2654435761)
+         + jnp.uint32(tick) * jnp.uint32(40503))
+    ang = (h >> 8).astype(jnp.float32) * (2.0 * np.pi / float(1 << 24))
+    step = jnp.stack([jnp.cos(ang), jnp.sin(ang)], -1) * geom.speed
+    eps = 1e-3
+    return jnp.clip(pos + step, eps, geom.extent - eps)
+
+
+def _pack_rows(sel, rank, budget, *arrays):
+    """Gather up to `budget` selected rows into fixed [budget] buffers.
+    sel: [n] bool, rank: [n] exclusive rank among selected.  Returns
+    (valid [budget] bool, packed arrays)."""
+    n = sel.shape[0]
+    idx = jnp.where(sel & (rank < budget), rank, budget)
+    valid = jnp.zeros((budget + 1,), bool).at[idx].set(sel)[:budget]
+    out = []
+    for a in arrays:
+        buf_shape = (budget + 1,) + a.shape[1:]
+        out.append(jnp.zeros(buf_shape, a.dtype).at[idx].set(a)[:budget])
+    return valid, out
+
+
+def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, active,
+                  tick):
+    """One tick on one shard (runs under shard_map; arrays are the
+    shard-local banks)."""
+    n = geom.n_shards
+    hs = geom.slab_h
+    w = geom.width
+    me = jax.lax.axis_index(axis)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+
+    # -- movement (identical math on any placement) ----------------------
+    pos = _walk(pos, gid, tick, geom)
+
+    cx = jnp.clip((pos[:, 0] / geom.cell_size).astype(jnp.int32), 0, w - 1)
+    cy = jnp.clip((pos[:, 1] / geom.cell_size).astype(jnp.int32), 0, w - 1)
+    owner = cy // hs
+
+    # -- migration: one budgeted ppermute per direction ------------------
+    migrated = jnp.int32(0)
+    mig_overflow = jnp.int32(0)
+    mig_dropped = jnp.int32(0)
+    banks = (pos, hp, atk, camp, gid)
+    for d, perm in ((1, fwd), (-1, bwd)):
+        # direction of travel, not exact neighbor: a row stranded 2+
+        # slabs from home (sustained budget overflow, or a teleport)
+        # hops one slab toward its owner per tick until it arrives —
+        # otherwise it would be excluded from combat forever
+        m = active & ((owner > me) if d == 1 else (owner < me))
+        csum = jnp.cumsum(m.astype(jnp.int32))
+        sel = m & (csum <= geom.mig_budget)
+        migrated = migrated + jnp.sum(sel, dtype=jnp.int32)
+        mig_overflow = mig_overflow + jnp.sum(m, dtype=jnp.int32) - jnp.sum(
+            sel, dtype=jnp.int32
+        )
+        valid, packed = _pack_rows(sel, csum - 1, geom.mig_budget, *banks)
+        rvalid = jax.lax.ppermute(valid, axis, perm)
+        rpacked = [jax.lax.ppermute(b, axis, perm) for b in packed]
+        # wrap-around sends are impossible (owner is clipped into range),
+        # but mask the circular receive anyway for edge shards
+        sender_ok = (me - d >= 0) & (me - d < n)
+        rvalid = rvalid & sender_ok
+        active = active & ~sel
+        # insert into free slots: dest[j] = row index of the j-th free slot
+        free = ~active
+        frank = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
+        slots = jnp.where(free & (frank < geom.mig_budget), frank,
+                          geom.mig_budget)
+        dest = (
+            jnp.full((geom.mig_budget + 1,), pos.shape[0], jnp.int32)
+            .at[slots]
+            .set(jnp.arange(pos.shape[0], dtype=jnp.int32))[: geom.mig_budget]
+        )
+        dest_j = jnp.where(rvalid, dest, pos.shape[0])  # OOB => dropped
+        mig_dropped = mig_dropped + jnp.sum(
+            rvalid & (dest_j >= pos.shape[0]), dtype=jnp.int32
+        )
+        new_banks = []
+        for cur, rb in zip(banks, rpacked):
+            new_banks.append(cur.at[dest_j].set(rb, mode="drop"))
+        pos, hp, atk, camp, gid = new_banks
+        active = active.at[dest_j].set(True, mode="drop")
+        banks = (pos, hp, atk, camp, gid)
+        # re-derive cells for rows that just arrived
+        cx = jnp.clip((pos[:, 0] / geom.cell_size).astype(jnp.int32), 0, w - 1)
+        cy = jnp.clip((pos[:, 1] / geom.cell_size).astype(jnp.int32), 0, w - 1)
+        owner = cy // hs
+
+    # -- local cell tables over the slab ---------------------------------
+    in_slab = active & (owner == me)
+    misplaced = jnp.sum(active & (owner != me), dtype=jnp.int32)
+    cell_local = (cy - me * hs) * w + cx
+    f32 = jnp.float32
+    camp_f = camp.astype(f32)
+    zeros_f = jnp.zeros_like(camp_f)
+    vic_feats = jnp.stack(
+        [pos[:, 0], pos[:, 1], camp_f, zeros_f, zeros_f], -1
+    )
+    attacking = (
+        in_slab
+        & (hp > 0)
+        & ((gid + tick) % geom.attack_period == 0)
+    )
+    eff_atk = jnp.where(attacking, atk, 0).astype(f32)
+    att_feats = jnp.stack(
+        [pos[:, 0], pos[:, 1], eff_atk, camp_f, zeros_f, zeros_f,
+         gid.astype(f32)],
+        -1,
+    )
+    vic_t, att_t = build_cell_table_pair(
+        pos, in_slab, vic_feats, attacking, att_feats,
+        geom.cell_size, w, geom.bucket, geom.att_bucket,
+        cell=cell_local, height=hs,
+    )
+
+    # -- halo exchange: one dense attacker plane per edge ----------------
+    ag = att_t.grid_view()  # [hs, w, K_att, F+1]
+    halo_top = jax.lax.ppermute(ag[hs - 1:hs], axis, fwd)   # prev's bottom
+    halo_bot = jax.lax.ppermute(ag[0:1], axis, bwd)          # next's top
+    halo_top = jnp.where(me > 0, halo_top, jnp.zeros_like(halo_top))
+    halo_bot = jnp.where(me < n - 1, halo_bot, jnp.zeros_like(halo_bot))
+    ag_h = jnp.concatenate([halo_top, ag, halo_bot], axis=0)  # [hs+2, ...]
+
+    # -- fold: same body as the single-chip engine, halo-aware walk ------
+    fold, init = combat_fold_closure(vic_t.grid_view(), geom.radius)
+    agp = jnp.pad(ag_h, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    acc = init
+    for dy in (0, 1, 2):  # (dy, dx) ascending == ops.stencil.STENCIL order
+        for dx in (0, 1, 2):
+            cand = jax.lax.slice(
+                agp, (dy, dx, 0, 0),
+                (dy + hs, dx + w, agp.shape[2], agp.shape[3]),
+            )
+            acc = fold(acc, cand)
+    inc, _besta, _bestr = acc
+
+    # -- damage -----------------------------------------------------------
+    pulled = pull(vic_t, inc, fill=0)
+    incoming = jnp.where(in_slab & (hp > 0), pulled, 0)
+    hp = jnp.maximum(hp - incoming, 0)
+
+    # columns: migrated, mig_overflow (budget), mig_dropped (no free
+    # slot), misplaced (awaiting retry), vic/att cell-bucket drops
+    stats = jnp.stack(
+        [migrated, mig_overflow, mig_dropped, misplaced,
+         vic_t.dropped, att_t.dropped]
+    )[None, :]  # [1, 6] per shard -> [n_shards, 6] outside
+    return pos, hp, atk, camp, gid, active, stats
+
+
+class SpatialWorld:
+    """Host wrapper: placement, compiled step, counters.
+
+    Usage:
+        geom = SpatialGeom(...)
+        world = SpatialWorld(geom)            # makes its own mesh
+        world.place(pos, hp, atk, camp)       # numpy rows, any order
+        world.step()                          # one jitted sharded tick
+        world.gather()                        # {gid -> (pos, hp)} to host
+    """
+
+    def __init__(self, geom: SpatialGeom, mesh: Optional[Mesh] = None,
+                 bank_size: Optional[int] = None):
+        if geom.width % geom.n_shards:
+            raise ValueError("width must divide into n_shards slabs")
+        self.geom = geom
+        self.mesh = mesh if mesh is not None else make_mesh(geom.n_shards)
+        self.axis = SHARD_AXIS
+        self.bank_size = bank_size
+        self.state: Optional[SpatialState] = None
+        self.tick_count = 0
+        self.stats_last = np.zeros((geom.n_shards, 6), np.int32)
+        self._step = None
+
+    # -- placement --------------------------------------------------------
+    def place(self, pos: np.ndarray, hp: np.ndarray, atk: np.ndarray,
+              camp: np.ndarray) -> None:
+        """Distribute entities into per-shard banks by their slab."""
+        g = self.geom
+        n = pos.shape[0]
+        cy = np.clip((pos[:, 1] / g.cell_size).astype(np.int32), 0,
+                     g.width - 1)
+        owner = cy // g.slab_h
+        counts = np.bincount(owner, minlength=g.n_shards)
+        bank = self.bank_size or int(1 << int(np.ceil(np.log2(
+            max(counts.max() * 2, 64)))))
+        cap = bank * g.n_shards
+        st = SpatialState(
+            pos=np.zeros((cap, 2), np.float32),
+            hp=np.zeros((cap,), np.int32),
+            atk=np.zeros((cap,), np.int32),
+            camp=np.zeros((cap,), np.int32),
+            gid=np.full((cap,), -1, np.int32),
+            active=np.zeros((cap,), bool),
+        )
+        fill = np.zeros(g.n_shards, np.int32)
+        for i in range(n):
+            s = owner[i]
+            if fill[s] >= bank:
+                raise ValueError(f"bank {s} overflow at placement")
+            r = s * bank + fill[s]
+            fill[s] += 1
+            st.pos[r] = pos[i]
+            st.hp[r] = hp[i]
+            st.atk[r] = atk[i]
+            st.camp[r] = camp[i]
+            st.gid[r] = i
+            st.active[r] = True
+        self.bank_size = bank
+        sh = NamedSharding(self.mesh, P(self.axis))
+        self.state = SpatialState(
+            *[jax.device_put(a, sh) for a in st]
+        )
+
+    # -- compiled step ----------------------------------------------------
+    def _build_step(self):
+        g = self.geom
+        body = partial(_spatial_body, g, self.axis)
+        row = P(self.axis)
+        rep = P()
+        smapped = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(row, row, row, row, row, row, rep),
+            out_specs=(row, row, row, row, row, row, row),
+            check_vma=False,
+        )
+        return jax.jit(smapped)
+
+    def step(self, n: int = 1) -> None:
+        if self._step is None:
+            self._step = self._build_step()
+        st = self.state
+        for _ in range(n):
+            t = jnp.int32(self.tick_count)
+            *banks, stats = self._step(
+                st.pos, st.hp, st.atk, st.camp, st.gid, st.active, t
+            )
+            st = SpatialState(*banks)
+            self.tick_count += 1
+        self.state = st
+        self.stats_last = np.asarray(stats)
+
+    # -- host observation -------------------------------------------------
+    def gather(self):
+        """{gid: (x, y, hp)} for live rows — host-side verification."""
+        st = jax.tree.map(np.asarray, self.state)
+        out = {}
+        for r in np.flatnonzero(st.active):
+            out[int(st.gid[r])] = (
+                float(st.pos[r, 0]), float(st.pos[r, 1]), int(st.hp[r])
+            )
+        return out
+
+
+def reference_step(geom: SpatialGeom, pos, hp, atk, camp, gid, active, tick):
+    """Single-device twin of the spatial tick (same movement, same
+    attacker duty, the square-grid combat_fold_xla) — the parity oracle
+    for tests and the global-sort side of the A/B."""
+    from ..game.combat import combat_fold_xla
+
+    pos = _walk(pos, gid, tick, geom)
+    f32 = jnp.float32
+    camp_f = camp.astype(f32)
+    zeros_f = jnp.zeros_like(camp_f)
+    vic_feats = jnp.stack([pos[:, 0], pos[:, 1], camp_f, zeros_f, zeros_f], -1)
+    attacking = active & (hp > 0) & ((gid + tick) % geom.attack_period == 0)
+    eff_atk = jnp.where(attacking, atk, 0).astype(f32)
+    att_feats = jnp.stack(
+        [pos[:, 0], pos[:, 1], eff_atk, camp_f, zeros_f, zeros_f,
+         gid.astype(f32)],
+        -1,
+    )
+    vic_t, att_t = build_cell_table_pair(
+        pos, active, vic_feats, attacking, att_feats,
+        geom.cell_size, geom.width, geom.bucket, geom.att_bucket,
+    )
+    inc, _bestr = combat_fold_xla(vic_t, att_t, geom.radius)
+    pulled = pull(vic_t, inc, fill=0)
+    incoming = jnp.where(active & (hp > 0), pulled, 0)
+    return pos, jnp.maximum(hp - incoming, 0)

@@ -1,0 +1,157 @@
+"""A/B: spatial slab sharding vs XLA-partitioned global sort.
+
+Runs the SAME combat computation (walk + cell tables + 3x3 fold +
+damage) at benchmark scale over an N-device mesh two ways:
+
+  global  — entity-axis sharding, one jit over the whole array; XLA
+            partitions the argsort into a distributed sort (the
+            parallel/shard.py strategy).
+  spatial — parallel/spatial.py: per-shard local sort, dense ppermute
+            halos, budgeted migration.
+
+On the virtual CPU mesh the absolute ms are NOT chip predictions, but
+compile time and the collective structure are real, and the two paths'
+results are cross-checked (identical HP totals within budgets).  Emits
+one JSON line for bench_runs/.
+
+Usage: python scripts/spatial_ab.py [--entities 524288] [--shards 8]
+                                    [--ticks 4]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--entities", type=int, default=524_288)
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--ticks", type=int, default=4)
+    args = ap.parse_args()
+
+    from noahgameframe_tpu.utils.platform import force_cpu, init_compile_cache
+
+    force_cpu(args.shards)
+    init_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from noahgameframe_tpu.ops.stencil import auto_bucket
+    from noahgameframe_tpu.parallel.mesh import make_mesh
+    from noahgameframe_tpu.parallel.spatial import (
+        SpatialGeom,
+        SpatialWorld,
+        reference_step,
+    )
+
+    n = args.entities
+    # benchmark density (~0.4/unit^2), cell 4.0 — same recipe as
+    # game.world.build_benchmark_world
+    extent = max(64.0, float(np.sqrt(n / 0.4)))
+    cell = 4.0
+    width = max(1, int(extent / cell))
+    width -= width % args.shards  # slab-divisible
+    extent = width * cell
+    # +8/+4 margin over the bench sizing: auto_bucket targets <0.1%
+    # drops, but WHICH rows drop depends on within-cell order, which
+    # differs between the two paths — zero drops makes parity exact
+    bucket = auto_bucket(n, width) + 8
+    att_bucket = auto_bucket(max(1, n // 30), width, lo=4, align=2) + 4
+    geom = SpatialGeom(
+        extent=extent, cell_size=cell, width=width, n_shards=args.shards,
+        bucket=bucket, att_bucket=att_bucket, radius=4.0,
+        mig_budget=max(1024, n // 64), speed=1.0, attack_period=30,
+    )
+
+    rng = np.random.default_rng(42)
+    pos = rng.uniform(1.0, extent - 1.0, (n, 2)).astype(np.float32)
+    hp = np.full(n, 1000, np.int32)
+    atk = rng.integers(5, 20, n).astype(np.int32)
+    camp = (np.arange(n) % 2).astype(np.int32)
+
+    out = {
+        "metric": "spatial_vs_global_sharded_combat",
+        "entities": n,
+        "shards": args.shards,
+        "ticks": args.ticks,
+        "geometry": {
+            "width": width, "slab_h": geom.slab_h, "bucket": bucket,
+            "att_bucket": att_bucket,
+        },
+        "unit": "ms/tick (virtual CPU mesh - structure, not chip truth)",
+    }
+
+    # -- spatial ----------------------------------------------------------
+    world = SpatialWorld(geom)
+    world.place(pos, hp, atk, camp)
+    t0 = time.perf_counter()
+    world.step()  # compile + first tick
+    out["spatial_compile_plus_first_tick_s"] = round(
+        time.perf_counter() - t0, 2
+    )
+    t0 = time.perf_counter()
+    world.step(args.ticks)
+    out["spatial_tick_ms"] = round(
+        1000 * (time.perf_counter() - t0) / args.ticks, 1
+    )
+    out["spatial_stats_last"] = {
+        k: int(v) for k, v in zip(
+            ("migrated", "mig_overflow", "mig_dropped", "misplaced",
+             "vic_dropped", "att_dropped"),
+            world.stats_last.sum(axis=0),
+        )
+    }
+    sp_hp_total = sum(h for _, _, h in world.gather().values())
+    spatial_ticks_total = world.tick_count
+
+    # -- global (entity-axis sharding, XLA-partitioned sort) --------------
+    mesh = make_mesh(args.shards)
+    row = NamedSharding(mesh, P("shard"))
+    gid = jax.device_put(jnp.arange(n, dtype=jnp.int32), row)
+    active = jax.device_put(jnp.ones(n, bool), row)
+    posj = jax.device_put(jnp.asarray(pos), row)
+    hpj = jax.device_put(jnp.asarray(hp), row)
+    atkj = jax.device_put(jnp.asarray(atk), row)
+    campj = jax.device_put(jnp.asarray(camp), row)
+
+    step = jax.jit(
+        lambda p, h, t: reference_step(geom, p, h, atkj, campj, gid,
+                                       active, t)
+    )
+    t0 = time.perf_counter()
+    posj, hpj = step(posj, hpj, jnp.int32(0))
+    jax.block_until_ready(hpj)
+    out["global_compile_plus_first_tick_s"] = round(
+        time.perf_counter() - t0, 2
+    )
+    t0 = time.perf_counter()
+    for t in range(1, args.ticks + 1):
+        posj, hpj = step(posj, hpj, jnp.int32(t))
+    jax.block_until_ready(hpj)
+    out["global_tick_ms"] = round(
+        1000 * (time.perf_counter() - t0) / args.ticks, 1
+    )
+
+    # -- cross-check ------------------------------------------------------
+    for t in range(args.ticks + 1, spatial_ticks_total):
+        posj, hpj = step(posj, hpj, jnp.int32(t))
+    # int64 host sum: int32 device accumulation wraps above ~2.1B total
+    # HP (the 4M ladder exceeds it)
+    gl_hp_total = int(np.asarray(hpj).astype(np.int64).sum())
+    out["hp_total_spatial"] = int(sp_hp_total)
+    out["hp_total_global"] = gl_hp_total
+    out["parity"] = bool(sp_hp_total == gl_hp_total)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

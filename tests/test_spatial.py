@@ -1,0 +1,211 @@
+"""Spatially-sharded combat core (parallel/spatial.py): slab partition,
+halo exchange, budgeted cross-shard migration.
+
+Parity oracle: `reference_step` — the same movement/duty math over the
+single-device square-grid fold (game.combat.combat_fold_xla).  Within
+budgets the two paths must produce bit-identical positions and HP."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from noahgameframe_tpu.parallel.spatial import (
+    SpatialGeom,
+    SpatialWorld,
+    reference_step,
+)
+
+
+def _mk_world(n=1500, seed=3, **over):
+    geom_kw = dict(
+        extent=128.0, cell_size=4.0, width=32, n_shards=4,
+        bucket=24, att_bucket=24, radius=4.0, mig_budget=512,
+        speed=1.0, attack_period=3,
+    )
+    geom_kw.update(over)
+    geom = SpatialGeom(**geom_kw)
+    rng = np.random.default_rng(seed)
+    pos = rng.uniform(1.0, geom.extent - 1.0, (n, 2)).astype(np.float32)
+    hp = np.full(n, 1000, np.int32)
+    atk = rng.integers(5, 20, n).astype(np.int32)
+    camp = (np.arange(n) % 2).astype(np.int32)
+    return geom, pos, hp, atk, camp
+
+
+def _run_reference(geom, pos, hp, atk, camp, ticks):
+    n = pos.shape[0]
+    gid = jnp.arange(n, dtype=jnp.int32)
+    active = jnp.ones(n, bool)
+    posj = jnp.asarray(pos)
+    hpj = jnp.asarray(hp)
+    atkj = jnp.asarray(atk)
+    campj = jnp.asarray(camp)
+    step = jax.jit(
+        lambda p, h, t: reference_step(
+            geom, p, h, atkj, campj, gid, active, t
+        )
+    )
+    for t in range(ticks):
+        posj, hpj = step(posj, hpj, jnp.int32(t))
+    return np.asarray(posj), np.asarray(hpj)
+
+
+def test_spatial_matches_single_device():
+    """20 ticks of movement + combat: every gid's position and HP match
+    the single-device engine bit-for-bit, and rows really migrated."""
+    geom, pos, hp, atk, camp = _mk_world()
+    ticks = 20
+
+    world = SpatialWorld(geom)
+    world.place(pos, hp, atk, camp)
+    migrated_total = 0
+    for _ in range(ticks):
+        world.step()
+        migrated_total += int(world.stats_last[:, 0].sum())
+        # generous budgets: nothing may overflow or drop
+        assert world.stats_last[:, 1:].sum() == 0, world.stats_last
+
+    ref_pos, ref_hp = _run_reference(geom, pos, hp, atk, camp, ticks)
+
+    got = world.gather()
+    assert len(got) == pos.shape[0]
+    for gid_, (x, y, hp_) in got.items():
+        assert hp_ == int(ref_hp[gid_]), f"gid {gid_} hp"
+        np.testing.assert_array_equal(
+            np.float32([x, y]), ref_pos[gid_], err_msg=f"gid {gid_} pos"
+        )
+    # the walk at speed 1.0 over 20 ticks must cross slab boundaries
+    assert migrated_total > 20, migrated_total
+    # and combat must actually have landed damage
+    damaged = sum(1 for _, (_, _, h) in got.items() if h < 1000)
+    assert damaged > len(got) * 0.5
+
+
+def test_spatial_halo_crosses_slab_boundary():
+    """Two enemies straddling a slab boundary within radius damage each
+    other even though they live on different shards (speed 0 => no
+    migration could have brought them together)."""
+    geom = SpatialGeom(
+        extent=64.0, cell_size=4.0, width=16, n_shards=2,
+        bucket=8, att_bucket=8, radius=4.0, mig_budget=8,
+        speed=0.0, attack_period=1,
+    )
+    # slab boundary at y = 8 cells * 4.0 = 32.0
+    pos = np.float32([[10.0, 31.0], [10.0, 33.0]])
+    hp = np.int32([100, 100])
+    atk = np.int32([7, 9])
+    camp = np.int32([0, 1])
+    world = SpatialWorld(geom)
+    world.place(pos, hp, atk, camp)
+    # placement: one row per slab
+    st = jax.tree.map(np.asarray, world.state)
+    owners = {int(st.gid[r]) for r in np.flatnonzero(st.active)
+              if r < world.bank_size}
+    assert owners == {0}, "gid 0 should live on shard 0"
+    world.step(3)
+    got = world.gather()
+    assert got[0][2] == 100 - 3 * 9, got  # hit by gid 1 across the halo
+    assert got[1][2] == 100 - 3 * 7, got
+    assert world.stats_last[:, 1:].sum() == 0
+
+
+def test_spatial_migration_budget_overflow_counts():
+    """A starved migration budget must not crash or corrupt the world:
+    overflow rows are counted, stay home, and retry."""
+    geom, pos, hp, atk, camp = _mk_world(n=800, mig_budget=1, speed=2.0)
+    world = SpatialWorld(geom)
+    world.place(pos, hp, atk, camp)
+    overflow_seen = 0
+    for _ in range(10):
+        world.step()
+        overflow_seen += int(world.stats_last[:, 1].sum())
+    got = world.gather()
+    # nothing lost: every entity still exists exactly once
+    assert len(got) == 800
+    assert overflow_seen > 0, "budget of 1 should have overflowed"
+
+
+def test_spatial_bank_full_drops_are_counted():
+    """If a destination bank has no free slot, the migrant is dropped
+    and counted (mig_dropped), not silently lost from accounting."""
+    geom = SpatialGeom(
+        extent=64.0, cell_size=4.0, width=16, n_shards=2,
+        bucket=64, att_bucket=8, radius=4.0, mig_budget=64,
+        speed=0.0, attack_period=97,
+    )
+    # all 8 rows on shard 0, banks sized exactly 8: shard 1's bank is
+    # FULL of... nothing — bank_size 8 leaves shard 1 all-free.  Fill
+    # shard 1 by placing 8 rows there too, then force one shard-0 row
+    # across the boundary by teleporting it (host-side surgery).
+    rng = np.random.default_rng(0)
+    pos = np.vstack([
+        rng.uniform([1, 1], [62, 30], (8, 2)),    # slab 0
+        rng.uniform([1, 33], [62, 62], (8, 2)),   # slab 1
+    ]).astype(np.float32)
+    hp = np.full(16, 100, np.int32)
+    atk = np.full(16, 5, np.int32)
+    camp = (np.arange(16) % 2).astype(np.int32)
+    world = SpatialWorld(geom, bank_size=8)
+    world.place(pos, hp, atk, camp)
+    st = world.state
+    # teleport shard-0 row 0 into slab 1 (y > 32): next tick it must
+    # migrate, but shard 1's bank (8/8 occupied) has no free slot
+    newpos = np.asarray(st.pos).copy()
+    newpos[0] = [10.0, 50.0]
+    world.state = st._replace(pos=jax.device_put(
+        jnp.asarray(newpos), st.pos.sharding
+    ))
+    world.step()
+    assert world.stats_last[:, 2].sum() == 1, world.stats_last
+    # the row is gone from shard 0 (it was sent) — by design the drop
+    # is visible in accounting, mirroring cell-overflow semantics
+    assert len(world.gather()) == 15
+
+
+def test_spatial_stranded_row_hops_home():
+    """A row teleported 3 slabs from its owner reaches it by hopping one
+    slab per tick (migration selects by direction of travel, not exact
+    neighbor) and resumes combat — never permanently stranded."""
+    geom = SpatialGeom(
+        extent=64.0, cell_size=4.0, width=16, n_shards=4,
+        bucket=8, att_bucket=8, radius=4.0, mig_budget=8,
+        speed=0.0, attack_period=1,
+    )
+    # gid 0 placed in slab 0, then teleported to slab 3 next to gid 1
+    # (an enemy); gid 2 keeps slab 0 non-empty
+    pos = np.float32([[10.0, 2.0], [10.0, 60.0], [20.0, 2.0]])
+    hp = np.int32([100, 100, 100])
+    atk = np.int32([5, 5, 5])
+    camp = np.int32([0, 1, 0])
+    world = SpatialWorld(geom)
+    world.place(pos, hp, atk, camp)
+    st = world.state
+    newpos = np.asarray(st.pos).copy()
+    rows0 = np.flatnonzero(np.asarray(st.active)[: world.bank_size])
+    g0 = next(r for r in rows0 if int(np.asarray(st.gid)[r]) == 0)
+    newpos[g0] = [10.0, 58.0]  # slab 3, within radius of gid 1
+    world.state = st._replace(pos=jax.device_put(
+        jnp.asarray(newpos), st.pos.sharding
+    ))
+    hops = []
+    for _ in range(4):
+        world.step()
+        hops.append(int(world.stats_last[:, 0].sum()))
+    # 3 hops (slab 0->1->2->3), then settled
+    assert hops[:3] == [1, 1, 1] and hops[3] == 0, hops
+    got = world.gather()
+    # all three rows still exist; gids 0 and 1 traded damage once they
+    # shared slab 3 (the first post-arrival tick)
+    assert len(got) == 3
+    assert got[0][2] < 100 and got[1][2] < 100, got
+    assert got[2][2] == 100
+
+
+def test_spatial_speed_zero_is_migration_free():
+    geom, pos, hp, atk, camp = _mk_world(n=300, speed=0.0)
+    world = SpatialWorld(geom)
+    world.place(pos, hp, atk, camp)
+    for _ in range(5):
+        world.step()
+        assert world.stats_last[:, 0].sum() == 0
