@@ -33,6 +33,10 @@ def main() -> None:
     ap.add_argument("--entities", type=int, default=524_288)
     ap.add_argument("--shards", type=int, default=8)
     ap.add_argument("--ticks", type=int, default=4)
+    ap.add_argument("--skip-global", action="store_true",
+                    help="spatial side only (the global sort at 4M on a "
+                         "virtual mesh costs minutes/tick; the 512k "
+                         "artifact already ranks the two)")
     args = ap.parse_args()
 
     from noahgameframe_tpu.utils.platform import force_cpu, init_compile_cache
@@ -112,6 +116,11 @@ def main() -> None:
     }
     sp_hp_total = sum(h for _, _, h in world.gather().values())
     spatial_ticks_total = world.tick_count
+    if args.skip_global:
+        out["hp_total_spatial"] = int(sp_hp_total)
+        out["global"] = "skipped"
+        print(json.dumps(out))
+        return
 
     # -- global (entity-axis sharding, XLA-partitioned sort) --------------
     mesh = make_mesh(args.shards)
