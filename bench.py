@@ -647,11 +647,29 @@ def main() -> None:
     if args.ticks is None:
         args.ticks = 90
 
+    # apply measured A/B winners (harvest queue -> scripts/decide_tuning.py
+    # -> bench_runs/tuning.json) on the TPU path only; explicit env vars
+    # still override via setdefault.  CPU fallbacks keep defaults — the
+    # tuning was measured on chip and does not transfer.
+    tuning_applied = {}
+    if args.platform == "tpu":
+        tpath = os.path.join(os.path.dirname(__file__), "bench_runs",
+                             "tuning.json")
+        try:
+            with open(tpath) as f:
+                for k, v in (json.load(f).get("env") or {}).items():
+                    if os.environ.setdefault(k, str(v)) == str(v):
+                        tuning_applied[k] = str(v)
+        except (OSError, json.JSONDecodeError, AttributeError):
+            pass
+
     try:
         payload = run_served(args) if args.served else run_bench(args)
         if probe_note:
             payload["detail"]["accelerator_probe_error"] = probe_note
             payload["detail"]["platform_fallback"] = "cpu"
+        if tuning_applied:
+            payload.setdefault("detail", {})["tuning_applied"] = tuning_applied
         _emit(payload)
     except Exception as e:  # noqa: BLE001
         import traceback

@@ -90,9 +90,18 @@ class SpatialState(NamedTuple):
 def _walk(pos, gid, tick, geom: SpatialGeom):
     """Deterministic per-gid random walk — a pure function of (gid,
     tick), so every shard placement computes the identical trajectory
-    (the parity tests rely on this)."""
+    (the parity tests rely on this).  The murmur3-style finalizer
+    matters: a LINEAR hash of (gid, tick) rotates each heading by a
+    constant ~0.9 deg/tick, producing near-straight paths that stick to
+    the clipped world walls and pile entire populations into corner
+    cells within ~100 ticks."""
     h = (gid.astype(jnp.uint32) * jnp.uint32(2654435761)
          + jnp.uint32(tick) * jnp.uint32(40503))
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
     ang = (h >> 8).astype(jnp.float32) * (2.0 * np.pi / float(1 << 24))
     step = jnp.stack([jnp.cos(ang), jnp.sin(ang)], -1) * geom.speed
     eps = 1e-3

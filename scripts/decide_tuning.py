@@ -1,0 +1,69 @@
+"""Promote measured A/B winners into bench_runs/tuning.json.
+
+The harvest queue captures the 1M tick under the default engines and
+under the opt-in variants (NF_RADIX=1/2 sort, NF_PALLAS=1 fold).  This
+script compares whatever captures exist and records the winning flag
+set, so later bench runs (including the driver's end-of-round one) use
+the fastest measured configuration instead of the defaults.  Env vars
+still override (bench.py applies tuning via setdefault).
+
+A variant must beat the baseline fused tick by >3% to be promoted —
+within that margin the default (simpler) engine wins ties.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+RUNS = os.path.join(os.path.dirname(__file__), "..", "bench_runs")
+MARGIN = 0.97
+
+
+def tick_ms(name: str):
+    path = os.path.join(RUNS, name)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        if "error" in d:
+            return None
+        return float(d["detail"]["tick_ms"])
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def main() -> None:
+    base = tick_ms("r05_tpu_1m.json")
+    if base is None:
+        print("no baseline 1M capture; not writing tuning", file=sys.stderr)
+        return
+    tuning: dict = {}
+    detail = {"baseline_tick_ms": base}
+
+    radix_variants = [
+        ("1", tick_ms("r05_tpu_1m_radix.json")),
+        ("2", tick_ms("r05_tpu_1m_radix2.json")),
+    ]
+    best_flag, best_ms = None, base * MARGIN
+    for flag, ms in radix_variants:
+        detail[f"radix{flag}_tick_ms"] = ms
+        if ms is not None and ms < best_ms:
+            best_flag, best_ms = flag, ms
+    if best_flag is not None:
+        tuning["NF_RADIX"] = best_flag
+
+    pallas_ms = tick_ms("r05_tpu_1m_pallas.json")
+    detail["pallas_tick_ms"] = pallas_ms
+    if pallas_ms is not None and pallas_ms < base * MARGIN:
+        tuning["NF_PALLAS"] = "1"
+
+    out = {"env": tuning, "detail": detail}
+    with open(os.path.join(RUNS, "tuning.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

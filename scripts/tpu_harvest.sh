@@ -80,6 +80,11 @@ while :; do
   run_item b1m_pallas 1800 env NF_PALLAS=1 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
     && save_json b1m_pallas bench_runs/r05_tpu_1m_pallas.json
 
+  # promote measured winners into bench_runs/tuning.json (re-runs are
+  # idempotent; no-op until the baseline 1M capture exists) so the
+  # driver's end-of-round bench uses the fastest measured engine flags
+  python -u scripts/decide_tuning.py || true
+
   # 6. served path on chip: tick + diff flush + interest fan-out, 500 sessions
   run_item serve100k 1800 python -u bench.py --entities 100000 --ticks 30 --served \
       --sessions 500 --interest-radius 8.0 --platform tpu \
