@@ -168,7 +168,9 @@ def run_served(args) -> dict:
     from noahgameframe_tpu.net.roles.base import RoleConfig
     from noahgameframe_tpu.net.roles.game import GameRole, Session
     from noahgameframe_tpu.net.wire import Ident, ident_key
+    from noahgameframe_tpu.utils.platform import init_compile_cache
 
+    init_compile_cache()
     n = args.entities
     # one live Player avatar per simulated session, + headroom (the
     # driver's served probe seats 500 — round-2 weak #6 follow-up: the
@@ -311,7 +313,9 @@ def run_bench(args) -> dict:
     import jax
 
     from noahgameframe_tpu.game import build_benchmark_world
+    from noahgameframe_tpu.utils.platform import init_compile_cache
 
+    init_compile_cache()
     n = args.entities
     world = build_benchmark_world(n, combat=not args.no_combat, seed=42)
     k = world.kernel
@@ -473,9 +477,12 @@ def _run_ladder(probe_note, serve_args) -> None:
                 cmd, capture_output=True, text=True, timeout=2400.0
             )
         except subprocess.TimeoutExpired:
+            # a rung that TIMES OUT (vs crashes) means the tunnel died
+            # mid-run — smaller rungs would hang for 2400 s each too, so
+            # stop laddering and let the caller fall back to CPU
             attempts.append({"entities": n, "outcome": "timeout"})
-            last_error = f"rung {n}: timeout"
-            continue
+            last_error = f"rung {n}: timeout (tunnel died mid-run)"
+            break
         line = None
         for ln in reversed((r.stdout or "").strip().splitlines()):
             if ln.startswith("{"):
@@ -532,6 +539,11 @@ def _run_ladder(probe_note, serve_args) -> None:
 
 
 def main() -> None:
+    # persistent XLA compile cache by default: the in-round harvest
+    # captures warm it, so the driver's end-of-round run of the same
+    # shapes skips the multi-minute 1M compile (explicit env overrides;
+    # set NF_COMPILE_CACHE= empty to disable)
+    os.environ.setdefault("NF_COMPILE_CACHE", "/tmp/nf_xla_cache")
     ap = argparse.ArgumentParser()
     # entities/ticks default to None so a CPU fallback can tell "driver
     # default" apart from a user-pinned size (argparse prefix matching
