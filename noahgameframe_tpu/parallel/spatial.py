@@ -69,6 +69,10 @@ class SpatialGeom(NamedTuple):
     mig_budget: int        # migrant rows per direction per shard per tick
     speed: float = 0.5     # random-walk step per tick (< cell_size)
     attack_period: int = 30  # a gid attacks every `attack_period` ticks
+    # the rest of the benchmark phase chain (0 disables either):
+    regen_per_tick: int = 0   # hp regained per tick while alive
+    hp_max: int = 0           # regen/respawn ceiling (0 = no ceiling)
+    respawn_ticks: int = 0    # dead rows revive at hp_max after this many
 
     @property
     def slab_h(self) -> int:
@@ -84,6 +88,7 @@ class SpatialState(NamedTuple):
     atk: jnp.ndarray     # [cap] i32
     camp: jnp.ndarray    # [cap] i32
     gid: jnp.ndarray     # [cap] i32 — stable global id, rides migration
+    died: jnp.ndarray    # [cap] i32 — tick of death, -1 while alive
     active: jnp.ndarray  # [cap] bool
 
 
@@ -122,8 +127,30 @@ def _pack_rows(sel, rank, budget, *arrays):
     return valid, out
 
 
-def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, active,
-                  tick):
+def _life_phases(geom: SpatialGeom, hp, died, incoming, tick):
+    """Damage -> death mark -> regen -> respawn, shared verbatim by the
+    spatial tick and the single-device parity oracle (pure elementwise,
+    placement-invariant)."""
+    hp_after = jnp.maximum(hp - incoming, 0)
+    died = jnp.where((hp > 0) & (hp_after == 0), tick, died)
+    if geom.regen_per_tick > 0:
+        regen = jnp.where(hp_after > 0, hp_after + geom.regen_per_tick,
+                          hp_after)
+        if geom.hp_max > 0:
+            regen = jnp.minimum(regen, geom.hp_max)
+        hp_after = regen
+    if geom.respawn_ticks > 0:
+        revive = (
+            (hp_after == 0) & (died >= 0)
+            & (tick - died >= geom.respawn_ticks)
+        )
+        hp_after = jnp.where(revive, geom.hp_max, hp_after)
+        died = jnp.where(revive, -1, died)
+    return hp_after, died
+
+
+def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, died,
+                  active, tick):
     """One tick on one shard (runs under shard_map; arrays are the
     shard-local banks)."""
     n = geom.n_shards
@@ -144,7 +171,7 @@ def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, active,
     migrated = jnp.int32(0)
     mig_overflow = jnp.int32(0)
     mig_dropped = jnp.int32(0)
-    banks = (pos, hp, atk, camp, gid)
+    banks = (pos, hp, atk, camp, gid, died)
     for d, perm in ((1, fwd), (-1, bwd)):
         # direction of travel, not exact neighbor: a row stranded 2+
         # slabs from home (sustained budget overflow, or a teleport)
@@ -182,9 +209,9 @@ def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, active,
         new_banks = []
         for cur, rb in zip(banks, rpacked):
             new_banks.append(cur.at[dest_j].set(rb, mode="drop"))
-        pos, hp, atk, camp, gid = new_banks
+        pos, hp, atk, camp, gid, died = new_banks
         active = active.at[dest_j].set(True, mode="drop")
-        banks = (pos, hp, atk, camp, gid)
+        banks = (pos, hp, atk, camp, gid, died)
         # re-derive cells for rows that just arrived
         cx = jnp.clip((pos[:, 0] / geom.cell_size).astype(jnp.int32), 0, w - 1)
         cy = jnp.clip((pos[:, 1] / geom.cell_size).astype(jnp.int32), 0, w - 1)
@@ -241,7 +268,7 @@ def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, active,
     # -- damage -----------------------------------------------------------
     pulled = pull(vic_t, inc, fill=0)
     incoming = jnp.where(in_slab & (hp > 0), pulled, 0)
-    hp = jnp.maximum(hp - incoming, 0)
+    hp, died = _life_phases(geom, hp, died, incoming, tick)
 
     # columns: migrated, mig_overflow (budget), mig_dropped (no free
     # slot), misplaced (awaiting retry), vic/att cell-bucket drops
@@ -249,7 +276,7 @@ def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, active,
         [migrated, mig_overflow, mig_dropped, misplaced,
          vic_t.dropped, att_t.dropped]
     )[None, :]  # [1, 6] per shard -> [n_shards, 6] outside
-    return pos, hp, atk, camp, gid, active, stats
+    return pos, hp, atk, camp, gid, died, active, stats
 
 
 class SpatialWorld:
@@ -295,6 +322,7 @@ class SpatialWorld:
             atk=np.zeros((cap,), np.int32),
             camp=np.zeros((cap,), np.int32),
             gid=np.full((cap,), -1, np.int32),
+            died=np.full((cap,), -1, np.int32),
             active=np.zeros((cap,), bool),
         )
         fill = np.zeros(g.n_shards, np.int32)
@@ -325,8 +353,8 @@ class SpatialWorld:
         smapped = jax.shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(row, row, row, row, row, row, rep),
-            out_specs=(row, row, row, row, row, row, row),
+            in_specs=(row, row, row, row, row, row, row, rep),
+            out_specs=(row, row, row, row, row, row, row, row),
             check_vma=False,
         )
         return jax.jit(smapped)
@@ -338,7 +366,8 @@ class SpatialWorld:
         for _ in range(n):
             t = jnp.int32(self.tick_count)
             *banks, stats = self._step(
-                st.pos, st.hp, st.atk, st.camp, st.gid, st.active, t
+                st.pos, st.hp, st.atk, st.camp, st.gid, st.died,
+                st.active, t
             )
             st = SpatialState(*banks)
             self.tick_count += 1
@@ -357,10 +386,12 @@ class SpatialWorld:
         return out
 
 
-def reference_step(geom: SpatialGeom, pos, hp, atk, camp, gid, active, tick):
+def reference_step(geom: SpatialGeom, pos, hp, atk, camp, gid, died, active,
+                   tick):
     """Single-device twin of the spatial tick (same movement, same
-    attacker duty, the square-grid combat_fold_xla) — the parity oracle
-    for tests and the global-sort side of the A/B."""
+    attacker duty, the square-grid combat_fold_xla, the same
+    _life_phases chain) — the parity oracle for tests and the
+    global-sort side of the A/B."""
     from ..game.combat import combat_fold_xla
 
     pos = _walk(pos, gid, tick, geom)
@@ -382,4 +413,5 @@ def reference_step(geom: SpatialGeom, pos, hp, atk, camp, gid, active, tick):
     inc, _bestr = combat_fold_xla(vic_t, att_t, geom.radius)
     pulled = pull(vic_t, inc, fill=0)
     incoming = jnp.where(active & (hp > 0), pulled, 0)
-    return pos, jnp.maximum(hp - incoming, 0)
+    hp, died = _life_phases(geom, hp, died, incoming, tick)
+    return pos, hp, died

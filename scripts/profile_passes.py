@@ -38,6 +38,9 @@ def main() -> None:
 
     if args.platform == "cpu":
         force_cpu()
+    # default-on cache: a harvest retry after a tunnel flap mid-run
+    # re-pays only the passes that never compiled
+    os.environ.setdefault("NF_COMPILE_CACHE", "/tmp/nf_xla_cache")
     init_compile_cache()
 
     import jax

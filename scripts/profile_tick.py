@@ -50,6 +50,12 @@ def main() -> None:
         from noahgameframe_tpu.utils.platform import force_cpu
 
         force_cpu()
+    import os
+
+    from noahgameframe_tpu.utils.platform import init_compile_cache
+
+    os.environ.setdefault("NF_COMPILE_CACHE", "/tmp/nf_xla_cache")
+    init_compile_cache()
 
     from noahgameframe_tpu.game import build_benchmark_world
     from noahgameframe_tpu.kernel.kernel import TickCtx

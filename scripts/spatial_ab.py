@@ -129,22 +129,23 @@ def main() -> None:
     active = jax.device_put(jnp.ones(n, bool), row)
     posj = jax.device_put(jnp.asarray(pos), row)
     hpj = jax.device_put(jnp.asarray(hp), row)
+    diedj = jax.device_put(jnp.full(n, -1, jnp.int32), row)
     atkj = jax.device_put(jnp.asarray(atk), row)
     campj = jax.device_put(jnp.asarray(camp), row)
 
     step = jax.jit(
-        lambda p, h, t: reference_step(geom, p, h, atkj, campj, gid,
-                                       active, t)
+        lambda p, h, dd, t: reference_step(geom, p, h, atkj, campj, gid,
+                                           dd, active, t)
     )
     t0 = time.perf_counter()
-    posj, hpj = step(posj, hpj, jnp.int32(0))
+    posj, hpj, diedj = step(posj, hpj, diedj, jnp.int32(0))
     jax.block_until_ready(hpj)
     out["global_compile_plus_first_tick_s"] = round(
         time.perf_counter() - t0, 2
     )
     t0 = time.perf_counter()
     for t in range(1, args.ticks + 1):
-        posj, hpj = step(posj, hpj, jnp.int32(t))
+        posj, hpj, diedj = step(posj, hpj, diedj, jnp.int32(t))
     jax.block_until_ready(hpj)
     out["global_tick_ms"] = round(
         1000 * (time.perf_counter() - t0) / args.ticks, 1
@@ -152,7 +153,7 @@ def main() -> None:
 
     # -- cross-check ------------------------------------------------------
     for t in range(args.ticks + 1, spatial_ticks_total):
-        posj, hpj = step(posj, hpj, jnp.int32(t))
+        posj, hpj, diedj = step(posj, hpj, diedj, jnp.int32(t))
     # int64 host sum: int32 device accumulation wraps above ~2.1B total
     # HP (the 4M ladder exceeds it)
     gl_hp_total = int(np.asarray(hpj).astype(np.int64).sum())

@@ -39,15 +39,16 @@ def _run_reference(geom, pos, hp, atk, camp, ticks):
     active = jnp.ones(n, bool)
     posj = jnp.asarray(pos)
     hpj = jnp.asarray(hp)
+    diedj = jnp.full(n, -1, jnp.int32)
     atkj = jnp.asarray(atk)
     campj = jnp.asarray(camp)
     step = jax.jit(
-        lambda p, h, t: reference_step(
-            geom, p, h, atkj, campj, gid, active, t
+        lambda p, h, dd, t: reference_step(
+            geom, p, h, atkj, campj, gid, dd, active, t
         )
     )
     for t in range(ticks):
-        posj, hpj = step(posj, hpj, jnp.int32(t))
+        posj, hpj, diedj = step(posj, hpj, diedj, jnp.int32(t))
     return np.asarray(posj), np.asarray(hpj)
 
 
@@ -209,6 +210,34 @@ def test_spatial_speed_zero_is_migration_free():
     for _ in range(5):
         world.step()
         assert world.stats_last[:, 0].sum() == 0
+
+
+def test_spatial_life_cycle_parity():
+    """With the full phase chain on (combat + regen + death + respawn),
+    entities die and revive while migrating across shards — HP stays
+    parity-exact with the single-device oracle."""
+    geom, pos, hp, atk, camp = _mk_world(
+        n=600, speed=1.0, attack_period=2,
+        regen_per_tick=1, hp_max=60, respawn_ticks=5,
+    )
+    hp = np.full_like(hp, 60)
+    ticks = 60
+    world = SpatialWorld(geom)
+    world.place(pos, hp, atk, camp)
+    for _ in range(ticks):
+        world.step()
+        assert world.stats_last[:, 1:].sum() == 0, world.stats_last
+    ref_pos, ref_hp = _run_reference(geom, pos, hp, atk, camp, ticks)
+    got = world.gather()
+    mismatch = [g for g, (_, _, h) in got.items() if h != int(ref_hp[g])]
+    assert not mismatch, mismatch[:5]
+    # the chain actually cycled: some rows are dead right now, some are
+    # back at full health having died earlier
+    dead_now = sum(1 for _, (_, _, h) in got.items() if h == 0)
+    assert dead_now > 0, "nothing died - config not lethal enough"
+    st = jax.tree.map(np.asarray, world.state)
+    revived = ((st.died == -1) & (st.hp == 60) & st.active).sum()
+    assert revived > 0
 
 
 def test_spatial_soak_conserves_entities():
