@@ -385,6 +385,25 @@ class SpatialWorld:
             )
         return out
 
+    # -- checkpoint / resume ----------------------------------------------
+    def save(self, path: str) -> None:
+        """Snapshot banks + tick counter; resuming continues the exact
+        trajectory (the walk/duty are pure functions of (gid, tick))."""
+        st = jax.tree.map(np.asarray, self.state)
+        np.savez_compressed(
+            path, tick=self.tick_count, bank=self.bank_size,
+            **st._asdict(),
+        )
+
+    def load(self, path: str) -> None:
+        with np.load(path) as z:
+            self.tick_count = int(z["tick"])
+            self.bank_size = int(z["bank"])
+            sh = NamedSharding(self.mesh, P(self.axis))
+            self.state = SpatialState(
+                *[jax.device_put(z[f], sh) for f in SpatialState._fields]
+            )
+
 
 def reference_step(geom: SpatialGeom, pos, hp, atk, camp, gid, died, active,
                    tick):

@@ -203,6 +203,26 @@ def test_spatial_stranded_row_hops_home():
     assert got[2][2] == 100
 
 
+def test_spatial_checkpoint_resume_continues_exactly(tmp_path):
+    """save -> load -> keep ticking reproduces the uncheckpointed
+    trajectory bit-for-bit (movement and duty are pure functions of
+    (gid, tick), so a resumed world cannot drift)."""
+    geom, pos, hp, atk, camp = _mk_world(n=500)
+    w1 = SpatialWorld(geom)
+    w1.place(pos, hp, atk, camp)
+    w1.step(7)
+    ckpt = str(tmp_path / "spatial.npz")
+    w1.save(ckpt)
+    w1.step(8)
+    expect = w1.gather()
+
+    w2 = SpatialWorld(geom)
+    w2.load(ckpt)
+    assert w2.tick_count == 7
+    w2.step(8)
+    assert w2.gather() == expect
+
+
 def test_spatial_speed_zero_is_migration_free():
     geom, pos, hp, atk, camp = _mk_world(n=300, speed=0.0)
     world = SpatialWorld(geom)
