@@ -17,7 +17,16 @@ from typing import List, Optional
 
 from .transport import EV_CONNECTED, EV_DISCONNECTED, NetEvent
 
-_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+# repo checkout layout by default; installed environments point
+# NF_NATIVE_DIR at a checkout of native/ (or anywhere holding
+# nfnet.cc/Makefile) — create_server/create_client fall back to the
+# pure-Python transport when neither exists
+import os as _os
+
+_NATIVE_DIR = Path(
+    _os.environ.get("NF_NATIVE_DIR")
+    or Path(__file__).resolve().parents[2] / "native"
+)
 _LIB_PATH = _NATIVE_DIR / "build" / "libnfnet.so"
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
