@@ -301,6 +301,8 @@ class SpatialWorld:
         self.state: Optional[SpatialState] = None
         self.tick_count = 0
         self.stats_last = np.zeros((geom.n_shards, 6), np.int32)
+        self.overflow_budget = 1e-4  # alert threshold, as CombatModule
+        self.overflow_alerts = 0
         self._step = None
 
     # -- placement --------------------------------------------------------
@@ -373,6 +375,32 @@ class SpatialWorld:
             self.tick_count += 1
         self.state = st
         self.stats_last = np.asarray(stats)
+        # runtime alerting, same contract as CombatModule's overflow
+        # budget (the counters alone are bench-only visibility):
+        # - mig_dropped rows left their source bank and found no free
+        #   slot at the destination — permanently LOST, always alert
+        # - budget-overflow/misplaced rows retry next tick and bucket
+        #   drops miss one tick of combat — alert above the budget
+        lost_forever = int(self.stats_last[:, 2].sum())
+        missed = int(self.stats_last[:, 1].sum()) + int(
+            self.stats_last[:, 4:].sum()
+        )
+        if lost_forever or missed:
+            pop = max(1, int(np.asarray(
+                jax.jit(lambda a: a.sum())(self.state.active)
+            )))
+            if lost_forever or missed / pop > self.overflow_budget:
+                self.overflow_alerts += 1
+                import logging
+
+                logging.getLogger("nf.spatial").warning(
+                    "spatial overflow: %d rows lost (bank full), %d "
+                    "missed combat/migration this tick (%.4f%% of %d, "
+                    "budget %.4f%%) - stats %s",
+                    lost_forever, missed, 100 * missed / pop, pop,
+                    100 * self.overflow_budget,
+                    self.stats_last.sum(axis=0).tolist(),
+                )
 
     # -- host observation -------------------------------------------------
     def gather(self):

@@ -113,7 +113,8 @@ def test_spatial_halo_crosses_slab_boundary():
 
 def test_spatial_migration_budget_overflow_counts():
     """A starved migration budget must not crash or corrupt the world:
-    overflow rows are counted, stay home, and retry."""
+    overflow rows are counted, stay home, retry — and the runtime ALERTS
+    (log + counter), it doesn't just expose a bench counter."""
     geom, pos, hp, atk, camp = _mk_world(n=800, mig_budget=1, speed=2.0)
     world = SpatialWorld(geom)
     world.place(pos, hp, atk, camp)
@@ -125,6 +126,7 @@ def test_spatial_migration_budget_overflow_counts():
     # nothing lost: every entity still exists exactly once
     assert len(got) == 800
     assert overflow_seen > 0, "budget of 1 should have overflowed"
+    assert world.overflow_alerts > 0, "breach must raise the alert counter"
 
 
 def test_spatial_bank_full_drops_are_counted():
