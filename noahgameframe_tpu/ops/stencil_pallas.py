@@ -105,13 +105,25 @@ def combat_fold_pallas(vic_table, att_table, radius: float, interpret: bool = Fa
     vic_table / att_table: ops.stencil.CellTable over the SAME grid
     geometry (vic carries 5 feature cols, att 7 — see module docstring).
     Returns (inc [H, W, Kv] int32, bestr [H, W, Kv] int32), matching the
-    XLA fold's outputs before `pull`."""
+    XLA fold's outputs before `pull`.
+
+    NF_PALLAS_ALIGN=<n> pads the lane (W) axis up to a multiple of n
+    (128 = TPU lane width) with zero-occupancy ghost cells — masked out
+    by the fold exactly like edge padding.  Insurance for grids whose W
+    (395 at the 1M benchmark) Mosaic may reject or tile poorly; costs
+    W_pad/W extra lanes, so it is opt-in until chip time ranks the two."""
+    import os
+
     width = vic_table.width
     assert att_table.width == width and att_table.cell_size == vic_table.cell_size
-    vic = _planes(vic_table.payload, width, vic_table.bucket, N_VFEATS, pad=False)
-    att = _planes(att_table.payload, width, att_table.bucket, N_AFEATS, pad=True)
+    align = int(os.environ.get("NF_PALLAS_ALIGN", "0") or 0)
+    w_pad = ((-width) % align) if align > 1 else 0
+    vic = _planes(vic_table.payload, width, vic_table.bucket, N_VFEATS,
+                  pad=False, w_pad=w_pad)
+    att = _planes(att_table.payload, width, att_table.bucket, N_AFEATS,
+                  pad=True, w_pad=w_pad)
     h = width
-    w = width
+    w = width + w_pad
     kv = vic.shape[2]
     ka = att.shape[2]
     vic_spec = pl.BlockSpec((1, N_VFEATS, kv, w), lambda y: (y, 0, 0, 0))
@@ -128,8 +140,11 @@ def combat_fold_pallas(vic_table, att_table, radius: float, interpret: bool = Fa
     )(vic, att, att, att)
     inc = jax.lax.bitcast_convert_type(
         out[:, 0].transpose(0, 2, 1), jnp.int32
-    )  # [H, W, Kv]
+    )  # [H, W(+pad), Kv]
     bestr = out[:, 2].transpose(0, 2, 1).astype(jnp.int32)
+    if w_pad:
+        inc = inc[:, :width]
+        bestr = bestr[:, :width]
     if kv > vic_table.bucket:
         inc = inc[..., : vic_table.bucket]
         bestr = bestr[..., : vic_table.bucket]
@@ -137,7 +152,7 @@ def combat_fold_pallas(vic_table, att_table, radius: float, interpret: bool = Fa
 
 
 def _planes(payload: jnp.ndarray, width: int, bucket: int, n_feats: int,
-            pad: bool) -> jnp.ndarray:
+            pad: bool, w_pad: int = 0) -> jnp.ndarray:
     """CellTable payload [(H*W*K)+1, F+1] -> feature planes.
 
     pad=True (attacker side) adds the one-cell zero border the shifted
@@ -146,14 +161,16 @@ def _planes(payload: jnp.ndarray, width: int, bucket: int, n_feats: int,
     (victim side, resident) gives [H, F, K, W].  K pads up to a multiple
     of 8 so the sublane axis stays tile-aligned on real TPUs (pad slots
     are all-zero; for victims the caller slices outputs back to K —
-    zero-slot victims never map back through `pull`)."""
+    zero-slot victims never map back through `pull`).  w_pad appends
+    zero-occupancy ghost cell columns for lane alignment (see
+    combat_fold_pallas)."""
     h = w = width
     k = bucket
     v = payload[:-1, :n_feats].reshape(h, w, k, n_feats)
     planes = v.transpose(0, 3, 2, 1)  # [H, F, K, W]
     k_pad = (-k) % 8
     if pad:
-        return jnp.pad(planes, ((1, 1), (0, 0), (0, k_pad), (1, 1)))
-    if k_pad:
-        return jnp.pad(planes, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
+        return jnp.pad(planes, ((1, 1), (0, 0), (0, k_pad), (1, 1 + w_pad)))
+    if k_pad or w_pad:
+        return jnp.pad(planes, ((0, 0), (0, 0), (0, k_pad), (0, w_pad)))
     return planes

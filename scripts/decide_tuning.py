@@ -55,9 +55,17 @@ def main() -> None:
         tuning["NF_RADIX"] = best_flag
 
     pallas_ms = tick_ms("r05_tpu_1m_pallas.json")
+    pallas_al_ms = tick_ms("r05_tpu_1m_pallas_aligned.json")
     detail["pallas_tick_ms"] = pallas_ms
-    if pallas_ms is not None and pallas_ms < base * MARGIN:
+    detail["pallas_aligned_tick_ms"] = pallas_al_ms
+    best_pallas = min(
+        (ms for ms in (pallas_ms, pallas_al_ms) if ms is not None),
+        default=None,
+    )
+    if best_pallas is not None and best_pallas < base * MARGIN:
         tuning["NF_PALLAS"] = "1"
+        if best_pallas == pallas_al_ms and pallas_al_ms != pallas_ms:
+            tuning["NF_PALLAS_ALIGN"] = "128"
 
     out = {"env": tuning, "detail": detail}
     with open(os.path.join(RUNS, "tuning.json"), "w") as f:

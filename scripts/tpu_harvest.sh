@@ -80,6 +80,12 @@ while :; do
   run_item b1m_pallas 1800 env NF_PALLAS=1 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
     && save_json b1m_pallas bench_runs/r05_tpu_1m_pallas.json
 
+  # 5b. lane-aligned Pallas variant (W=395 is not a 128 multiple; if
+  #     Mosaic rejects or tiles the unaligned kernel poorly, this one
+  #     pads W to 512 with masked ghost cells)
+  run_item b1m_pallas_al 1800 env NF_PALLAS=1 NF_PALLAS_ALIGN=128 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
+    && save_json b1m_pallas_al bench_runs/r05_tpu_1m_pallas_aligned.json
+
   # promote measured winners into bench_runs/tuning.json (re-runs are
   # idempotent; no-op until the baseline 1M capture exists) so the
   # driver's end-of-round bench uses the fastest measured engine flags
@@ -107,7 +113,7 @@ while :; do
     && save_json b100k_walk bench_runs/r05_tpu_100k_nocombat.json
 
   n_done=$(ls "$STAMPS" | wc -l)
-  if [ "$n_done" -ge 12 ]; then
+  if [ "$n_done" -ge 13 ]; then
     echo "[$(date -u +%H:%M:%S)] queue drained — exiting"
     exit 0
   fi

@@ -73,3 +73,20 @@ def test_pallas_fold_matches_xla_fold_asymmetric_buckets():
         np.asarray(a.kernel.state.classes["NPC"].i32),
         np.asarray(b.kernel.state.classes["NPC"].i32),
     )
+
+
+def test_pallas_fold_lane_aligned_matches(monkeypatch):
+    """NF_PALLAS_ALIGN pads the lane (W) axis with zero-occupancy ghost
+    cells for TPU lane alignment — results must stay bit-identical to
+    the unpadded kernel (grid width 37 -> padded 128)."""
+    monkeypatch.setenv("NF_PALLAS_ALIGN", "128")
+    a = build(200, 31, use_pallas=False)
+    b = build(200, 31, use_pallas=True)
+    assert b.combat.width % 128 != 0  # the pad actually engages
+    for _ in range(6):
+        a.tick()
+        b.tick()
+    np.testing.assert_array_equal(
+        np.asarray(a.kernel.state.classes["NPC"].i32),
+        np.asarray(b.kernel.state.classes["NPC"].i32),
+    )
