@@ -121,6 +121,9 @@ class GameClient:
         self.team_acks: list = []
         self.guild_acks: list = []
         self.guild_search: list = []
+        self.slg_acks: list = []
+        self.pvp_matches: list = []   # AckPVPApplyMatch (room assignments)
+        self.pvp_ectypes: list = []   # AckCreatePVPEctype (instance grants)
         self._handlers: Dict[int, Callable[[MsgBase], None]] = {}
         self._install()
 
@@ -176,6 +179,20 @@ class GameClient:
                                              ReqAckLeaveGuild)
         h[int(MsgID.ACK_SEARCH_GUILD)] = keep(self.guild_search,
                                               AckSearchGuild)
+        from ..net.wire import AckCreatePVPEctype, AckPVPApplyMatch
+        from ..net.wire_families import (
+            ReqAckBuyObjectFormShop,
+            ReqAckMoveBuildObject,
+        )
+
+        h[int(MsgID.ACK_BUY_FORM_SHOP)] = keep(self.slg_acks,
+                                               ReqAckBuyObjectFormShop)
+        h[int(MsgID.ACK_MOVE_BUILD_OBJECT)] = keep(self.slg_acks,
+                                                   ReqAckMoveBuildObject)
+        h[int(MsgID.ACK_PVP_APPLY_MATCH)] = keep(self.pvp_matches,
+                                                 AckPVPApplyMatch)
+        h[int(MsgID.ACK_CREATE_PVP_ECTYPE)] = keep(self.pvp_ectypes,
+                                                   AckCreatePVPEctype)
 
     def connect(self, host: str, port: int) -> None:
         """Dial an endpoint (login first, later the granted proxy)."""
@@ -624,6 +641,84 @@ class GameClient:
 
     def _on_skill(self, base: MsgBase) -> None:
         self.skills.append(ReqAckUseSkill.decode(base.msg_data))
+
+    # ------------------------------------------------- SLG city building
+    # client side of NFCSLGShopModule / NFCSLGBuildingModule's wire
+    # surface (EGEC_REQ_BUY_FORM_SHOP .. EGEC_REQ_BUILD_OPERATE)
+    def slg_buy(self, shop_id: str, x: float, y: float,
+                z: float = 0.0) -> None:
+        from ..net.wire_families import ReqAckBuyObjectFormShop
+
+        self._send(MsgID.REQ_BUY_FORM_SHOP, ReqAckBuyObjectFormShop(
+            config_id=shop_id.encode(), x=x, y=y, z=z,
+        ))
+
+    def slg_move(self, row: int, x: float, y: float, z: float = 0.0) -> None:
+        from ..net.wire_families import ReqAckMoveBuildObject
+
+        self._send(MsgID.REQ_MOVE_BUILD_OBJECT, ReqAckMoveBuildObject(
+            row=row, x=x, y=y, z=z,
+        ))
+
+    def slg_upgrade(self, row: int) -> None:
+        from ..net.wire_families import ReqUpBuildLv
+
+        self._send(MsgID.REQ_UP_BUILD_LVL, ReqUpBuildLv(row=row))
+
+    def slg_produce(self, row: int, config_id: str, count: int = 1) -> None:
+        from ..net.wire_families import ReqCreateItem
+
+        self._send(MsgID.REQ_CREATE_ITEM, ReqCreateItem(
+            row=row, config_id=config_id.encode(), count=count,
+        ))
+
+    def slg_operate(self, row: int, functype: int) -> None:
+        from ..net.wire_families import ReqBuildOperate
+
+        self._send(MsgID.REQ_BUILD_OPERATE, ReqBuildOperate(
+            row=row, functype=int(functype),
+        ))
+
+    def slg_collect(self, row: int, resource: str = "Gold") -> None:
+        from ..net.wire_families import SLGFuncType
+
+        self.slg_operate(row, int(SLGFuncType[f"COLLECT_{resource.upper()}"]))
+
+    # --------------------------------------------------------- GM + PVP
+    def gm_command(self, command_id: int, str_value: str = "",
+                   int_value: int = 0) -> None:
+        """EGMI_REQ_CMD_NORMAL: 0 = set int property, 1 = give item,
+        3 = add exp (gated by the avatar's GMLevel server-side)."""
+        from ..net.wire import ReqCommand
+
+        self._send(MsgID.REQ_CMD_NORMAL, ReqCommand(
+            command_id=int(command_id),
+            command_str_value=str_value.encode() or None,
+            command_value_int=int_value,
+        ))
+
+    def pvp_apply_match(self, mode: int = 0,
+                        score: int | None = None) -> None:
+        """Queue for PVP matchmaking; the room assignment arrives as
+        AckPVPApplyMatch in `pvp_matches` (both fighters get it)."""
+        from ..net.wire import ReqPVPApplyMatch
+
+        self._send(MsgID.REQ_PVP_APPLY_MATCH, ReqPVPApplyMatch(
+            self_id=self.player_guid, nPVPMode=mode, score=score,
+        ))
+
+    def pvp_create_ectype(self, room=None) -> None:
+        """Mint the PVP instance for a granted room (defaults to the
+        most recent match's room)."""
+        from ..net.wire import ReqCreatePVPEctype
+
+        if room is None and self.pvp_matches:
+            room = self.pvp_matches[-1].xRoomInfo
+        if room is None:
+            return
+        self._send(MsgID.REQ_CREATE_PVP_ECTYPE, ReqCreatePVPEctype(
+            self_id=self.player_guid, xRoomInfo=room,
+        ))
 
     def close(self) -> None:
         if self._conn is not None:

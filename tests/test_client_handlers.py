@@ -497,3 +497,88 @@ def test_pvp_survivor_notified_and_reapply_switches_mode(rig):
          ReqPVPApplyMatch(nPVPMode=2, score=70))
     tickets = [t for t in world.pvp.queue if t.player == a]
     assert [(t.mode, t.score) for t in tickets] == [(2, 70)]
+
+
+def test_sdk_slg_gm_pvp_over_real_sockets():
+    """The round-5 client surface end to end: GM commands, SLG city
+    building, and PVP matchmaking ride the SDK through the five-role
+    cluster to the game handlers and back (reference NFClient flow)."""
+    from noahgameframe_tpu.client import GameClient
+    from noahgameframe_tpu.game.defines import EShopType, ItemType
+    from noahgameframe_tpu.net.roles import LocalCluster
+
+    c = LocalCluster(http_port=0)
+    c.start(timeout=25.0)
+    try:
+        gw = c.game.game_world
+        e = gw.kernel.elements
+        e.add_element("Building", "barracks", {"Type": 2})
+        e.add_element("Shop", "shop_barracks", {
+            "Type": int(EShopType.BUILDING), "Level": 3,
+            "Gold": 100, "ItemID": "barracks"})
+        e.add_element("Item", "gm_box", {"ItemType": int(ItemType.ITEM)})
+
+        clis = []
+        for name in ("reda", "blub"):
+            cli = GameClient(name)
+            cli.connect("127.0.0.1", c.login.config.port)
+
+            def pump(cond, t=12.0, cli=cli):
+                assert c.pump_until(cond, extra=cli.execute, timeout=t), \
+                    "timeout"
+
+            pump(lambda: cli.connected)
+            cli.login(); pump(lambda: cli.logged_in)
+            cli.request_world_list(); pump(lambda: cli.worlds)
+            cli.connect_world(cli.worlds[0].server_id)
+            pump(lambda: cli.world_grant is not None)
+            cli.connect_proxy(); pump(lambda: cli.connected)
+            cli.verify_key(); pump(lambda: cli.key_verified)
+            cli.select_server(c.game.config.server_id)
+            pump(lambda: cli.server_selected)
+            cli.create_role(name.title()); pump(lambda: cli.roles)
+            cli.enter_game(name.title()); pump(lambda: cli.entered)
+            clis.append((cli, pump))
+        (a, pump_a), (b, pump_b) = clis
+
+        k = gw.kernel
+        guids = {str(k.get_property(g, "Account")): g
+                 for g in list(c.game._guid_session)}
+        ga, gb = guids["reda"], guids["blub"]
+
+        # GM: denied without GMLevel, then sets the named property
+        a.gm_command(0, "Level", 5)
+        k.set_property(ga, "GMLevel", 1)
+        a.gm_command(0, "Level", 5)
+        pump_a(lambda: int(k.get_property(ga, "Level")) == 5)
+        # GM item grant reaches the bag
+        a.gm_command(1, "gm_box", 2)
+        pump_a(lambda: gw.pack.item_count(ga, "gm_box") == 2)
+
+        # SLG: buy a building through the wire, then move it
+        k.set_property(ga, "Gold", 500)
+        a.slg_buy("shop_barracks", 10.0, 10.0)
+        pump_a(lambda: a.slg_acks)
+        rows = gw.slg_building.buildings(ga)
+        assert rows, "building record row missing after buy"
+        a.slg_move(next(iter(rows)), 14.0, 18.0)
+        pump_a(lambda: len(a.slg_acks) >= 2)
+
+        # PVP: both apply, both get the room, one mints the ectype
+        # (pump BOTH clients: each client's socket drains in its own
+        # execute(), so b's apply only leaves when b is pumped too)
+        def pump_ab(cond, t=12.0):
+            assert c.pump_until(
+                cond, extra=lambda: (a.execute(), b.execute()), timeout=t
+            ), "timeout"
+
+        k.set_property(gb, "Level", 5)  # close scores pair immediately
+        a.pvp_apply_match(mode=1)
+        b.pvp_apply_match(mode=1)
+        pump_ab(lambda: a.pvp_matches and b.pvp_matches)
+        room_a = a.pvp_matches[-1].xRoomInfo
+        assert room_a is not None and room_a.RoomID is not None
+        a.pvp_create_ectype()
+        pump_ab(lambda: a.pvp_ectypes)
+    finally:
+        c.shut()
