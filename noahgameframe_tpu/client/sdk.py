@@ -684,6 +684,29 @@ class GameClient:
 
         self.slg_operate(row, int(SLGFuncType[f"COLLECT_{resource.upper()}"]))
 
+    def set_fight_hero(self, hero_row: int, fight_pos: int = 0) -> None:
+        """EGEC_REQ_SET_FIGHT_HERO: pick the battle line-up hero by its
+        PlayerHero record row (heroes are row-identified)."""
+        from ..net.wire import ReqSetFightHero
+
+        self._send(MsgID.REQ_SET_FIGHT_HERO, ReqSetFightHero(
+            selfid=self.player_guid,
+            heroid=Ident(svrid=0, index=hero_row),
+            fight_pos=fight_pos,
+        ))
+
+    def switch_server(self, target_game_id: int, scene_id: int = 1,
+                      group_id: int = 0) -> None:
+        """EGMI_REQSWICHSERVER (OnClientReqSwichServer): ask to be
+        re-homed onto another game server; the proxy re-routes after the
+        blob lands there."""
+        from ..net.wire import ReqSwitchServer
+
+        self._send(MsgID.REQ_SWITCH_SERVER, ReqSwitchServer(
+            selfid=self.player_guid, target_serverid=target_game_id,
+            scene_id=scene_id, group_id=group_id,
+        ))
+
     # --------------------------------------------------------- GM + PVP
     def gm_command(self, command_id: int, str_value: str = "",
                    int_value: int = 0) -> None:

@@ -582,3 +582,38 @@ def test_sdk_slg_gm_pvp_over_real_sockets():
         pump_ab(lambda: a.pvp_ectypes)
     finally:
         c.shut()
+
+
+def test_sdk_set_fight_hero_bytes_drive_the_server(rig):
+    """GameClient.set_fight_hero's exact wire bytes (re-stamped with the
+    proxy's player id, as the real proxy does) land the hero in the
+    PlayerFightHero line-up."""
+    from noahgameframe_tpu.client import GameClient
+    from noahgameframe_tpu.game import ItemType
+
+    world, role, seat, send, acks = rig
+    e = world.kernel.elements
+    e.add_element("Item", "hero_mage", {"ItemType": int(ItemType.CARD),
+                                        "ATK_VALUE": 4})
+    ident, g = seat(1, "ann")
+    row = world.heroes.add_hero(g, "hero_mage")
+
+    cli = GameClient("ann")
+    captured = []
+
+    class FakeConn:
+        def send_msg(self, mid, body):
+            captured.append((mid, body))
+            return True
+
+    cli._conn = FakeConn()
+    cli.set_fight_hero(row, fight_pos=1)
+    (mid, body), = captured
+    assert mid == int(MsgID.REQ_SET_FIGHT_HERO)
+    # the proxy stamps the player ident onto the envelope in flight
+    base = MsgBase.decode(body)
+    role.server.dispatch.feed([
+        NetEvent(EV_MSG, 101, mid,
+                 MsgBase(player_id=ident, msg_data=base.msg_data).encode())
+    ])
+    assert world.heroes.fight_hero(g, 1) == row
