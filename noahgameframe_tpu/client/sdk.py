@@ -598,6 +598,13 @@ class GameClient:
     def leave_team(self) -> None:
         self._send(MsgID.REQ_LEAVE_TEAM, ReqAckLeaveTeam())
 
+    def opr_team_member(self, team_id: "Ident", member: "Ident",
+                        op_type: int) -> None:
+        """EGMI_REQ_OPRMEMBER_TEAM: captain member ops (KICK etc.)."""
+        self._send(MsgID.REQ_OPRMEMBER_TEAM, ReqAckOprTeamMember(
+            team_id=team_id, member_id=member, type=int(op_type),
+        ))
+
     def create_guild(self, name: str) -> None:
         self._send(MsgID.REQ_CREATE_GUILD,
                    ReqAckCreateGuild(guild_name=name.encode()))
