@@ -74,7 +74,9 @@ def main() -> None:
 
     def timed(name, fn, *a):
         """Median-free single measurement: warmup compile, then `reps`
-        queued dispatches with one terminal block (RTT/reps pollution)."""
+        queued dispatches with one terminal block (RTT/reps pollution).
+        The accumulated JSON reprints after EVERY pass (last line wins)
+        so a tunnel death mid-run still leaves decision-grade data."""
         try:
             r = fn(*a)
             jax.block_until_ready(r)
@@ -88,6 +90,7 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — record and keep going
             out["passes"][name] = f"ERROR {type(e).__name__}: {e}"
             print(f"# {name}: FAILED {e}", file=sys.stderr, flush=True)
+        print(json.dumps(out), flush=True)
 
     # -- the whole fused tick (1 tick per dispatch) ---------------------------
     k.run_device(1)  # compile + host reconcile once

@@ -65,8 +65,16 @@ while :; do
 
   # 3b. isolated per-pass timings at 1M (sort vs build vs fold vs scatter —
   #     arbitrates docs/ROOFLINE.md's suspects independent of phase nesting)
-  run_item passes1m 1800 python -u scripts/profile_passes.py --entities 1000000 --reps 10 \
-    && grep -o '^{.*}$' /tmp/harvest_passes1m.out | tail -1 > bench_runs/r05_passes_1m.json
+  if run_item passes1m 1800 python -u scripts/profile_passes.py --entities 1000000 --reps 10; then
+    grep -o '^{.*}$' /tmp/harvest_passes1m.out | tail -1 > bench_runs/r05_passes_1m.json
+  else
+    # salvage partial pass timings (profile_passes reprints the JSON
+    # after every pass) WITHOUT stamping, so a retry still completes it
+    grep -o '^{.*}$' /tmp/harvest_passes1m.out 2>/dev/null | tail -1 \
+      > /tmp/passes_partial.$$ && [ -s /tmp/passes_partial.$$ ] \
+      && mv /tmp/passes_partial.$$ bench_runs/r05_passes_1m_partial.json
+    rm -f /tmp/passes_partial.$$
+  fi
 
   # 4. radix-sort A/B at 1M (docs/ROOFLINE.md prime suspect)
   run_item b1m_radix 1800 env NF_RADIX=1 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
