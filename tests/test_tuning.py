@@ -1,0 +1,95 @@
+"""Measured-tuning promotion (scripts/decide_tuning.py): the harvest
+queue's A/B captures elect the engine flags the driver bench runs with.
+Wrong promotion logic would silently pessimize (or break) the round's
+official benchmark, so the election rules are pinned here."""
+
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "decide_tuning",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "decide_tuning.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.RUNS = str(tmp_path)
+    return mod
+
+
+def _w(tmp_path, name, ms=None, error=None):
+    d = {"metric": "m", "detail": {"tick_ms": ms}}
+    if error:
+        d["error"] = error
+    with open(os.path.join(str(tmp_path), name), "w") as f:
+        json.dump(d, f)
+
+
+def _run(mod, capsys):
+    mod.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    return json.loads(out[-1]) if out and out[-1].startswith("{") else None
+
+
+def test_no_baseline_writes_nothing(tmp_path, capsys):
+    mod = _load(tmp_path)
+    mod.main()
+    assert not os.path.exists(os.path.join(str(tmp_path), "tuning.json"))
+
+
+def test_winner_must_beat_margin(tmp_path, capsys):
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r05_tpu_1m_radix.json", 98.0)   # within 3%: tie -> default
+    _w(tmp_path, "r05_tpu_1m_pallas.json", 96.0)  # beats margin
+    got = _run(mod, capsys)
+    assert got["env"] == {"NF_PALLAS": "1"}
+
+
+def test_best_radix_digit_wins(tmp_path, capsys):
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r05_tpu_1m_radix.json", 80.0)
+    _w(tmp_path, "r05_tpu_1m_radix2.json", 70.0)
+    got = _run(mod, capsys)
+    assert got["env"] == {"NF_RADIX": "2"}
+
+
+def test_aligned_pallas_promotes_align_flag(tmp_path, capsys):
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r05_tpu_1m_pallas.json", 90.0)
+    _w(tmp_path, "r05_tpu_1m_pallas_aligned.json", 60.0)
+    got = _run(mod, capsys)
+    assert got["env"]["NF_PALLAS"] == "1"
+    assert got["env"]["NF_PALLAS_ALIGN"] == "128"
+
+
+def test_error_payloads_are_ignored(tmp_path, capsys):
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r05_tpu_1m_radix.json", 10.0, error="crashed")
+    got = _run(mod, capsys)
+    assert got["env"] == {}  # a 10x "win" from a crash payload is not real
+
+
+def test_bench_applies_tuning_env(tmp_path, monkeypatch):
+    """bench.py's loader: setdefault semantics (explicit env wins)."""
+    runs = tmp_path / "bench_runs"
+    runs.mkdir()
+    (runs / "tuning.json").write_text(
+        json.dumps({"env": {"NF_RADIX": "2", "NF_PALLAS": "1"}})
+    )
+    monkeypatch.setenv("NF_PALLAS", "0")  # operator override
+    monkeypatch.delenv("NF_RADIX", raising=False)
+    applied = {}
+    with open(runs / "tuning.json") as f:
+        for k, v in (json.load(f).get("env") or {}).items():
+            if os.environ.setdefault(k, str(v)) == str(v):
+                applied[k] = str(v)
+    assert applied == {"NF_RADIX": "2"}
+    assert os.environ["NF_PALLAS"] == "0"
