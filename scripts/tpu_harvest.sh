@@ -76,6 +76,9 @@ while :; do
     rm -f /tmp/passes_partial.$$
   fi
 
+  # 3c. op-level xplane trace of the fused tick (offline analysis)
+  run_item trace1m 1200 python -u scripts/capture_trace.py --entities 1000000 --ticks 3
+
   # 4. radix-sort A/B at 1M (docs/ROOFLINE.md prime suspect)
   run_item b1m_radix 1800 env NF_RADIX=1 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
     && save_json b1m_radix bench_runs/r05_tpu_1m_radix.json
@@ -121,7 +124,7 @@ while :; do
     && save_json b100k_walk bench_runs/r05_tpu_100k_nocombat.json
 
   n_done=$(ls "$STAMPS" | wc -l)
-  if [ "$n_done" -ge 13 ]; then
+  if [ "$n_done" -ge 14 ]; then
     echo "[$(date -u +%H:%M:%S)] queue drained — exiting"
     exit 0
   fi
