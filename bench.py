@@ -82,20 +82,67 @@ def _evict_harvester() -> None:
 
     try:
         r = subprocess.run(
-            ["pgrep", "-f", "scripts/tpu_harvest"],
+            # anchored: match the harvester SHELL, not any process that
+            # merely mentions the path (an editor/tail on the script)
+            ["pgrep", "-f", r"bash .*scripts/tpu_harvest\.sh"],
             capture_output=True, text=True, timeout=10,
         )
+        victims = []
+        my_pgid = os.getpgid(0)
         for line in (r.stdout or "").split():
             try:
                 pid = int(line)
                 pgid = os.getpgid(pid)
-                os.killpg(pgid, signal.SIGTERM)
+                if pgid == my_pgid:
+                    # harvester launched from OUR process group (no job
+                    # control): killpg would take bench.py down with it —
+                    # kill the pid alone
+                    os.kill(pid, signal.SIGTERM)
+                else:
+                    os.killpg(pgid, signal.SIGTERM)
+                victims.append(pid)
                 print(f"# evicted harvester pid {pid} (pgid {pgid})",
                       file=sys.stderr)
             except (ValueError, ProcessLookupError, PermissionError):
                 pass
+        # the harvester's in-flight CAPTURE child is what actually holds
+        # the TPU claim — kill it directly too (killpg already covers it
+        # unless the harvester shared OUR pgid and was pid-killed above)
+        r2 = subprocess.run(
+            ["pgrep", "-f", r"python -u .*(bench\.py|profile_\w+\.py|"
+                            r"capture_trace\.py) .*--platform tpu|"
+                            r"python -u scripts/(profile_passes|"
+                            r"profile_tick|capture_trace)\.py"],
+            capture_output=True, text=True, timeout=10,
+        )
+        for line in (r2.stdout or "").split():
+            try:
+                pid = int(line)
+                if pid != os.getpid():
+                    os.kill(pid, signal.SIGTERM)
+                    victims.append(pid)
+            except (ValueError, ProcessLookupError, PermissionError):
+                pass
+        # wait (bounded) for the TPU claim to actually release — probing
+        # while the dying capture still tears down PJRT would hang to
+        # timeout exactly like the race this function exists to prevent
+        deadline = time.monotonic() + 15.0
+        while victims and time.monotonic() < deadline:
+            victims = [p for p in victims if _pid_alive(p)]
+            if victims:
+                time.sleep(0.25)
     except Exception:  # noqa: BLE001 — eviction is best-effort
         pass
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
 
 
 def _emit(payload: dict) -> None:
