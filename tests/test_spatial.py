@@ -293,3 +293,26 @@ def test_spatial_soak_conserves_entities():
     got = world.gather()
     mismatches = [g for g, (_, _, h) in got.items() if h != int(ref_hp[g])]
     assert not mismatches, mismatches[:5]
+
+
+def test_spatial_single_shard_degenerate():
+    """n_shards=1: self-permutes, no real neighbors, halos masked to
+    zero — combat still lands and nothing migrates or overflows."""
+    geom = SpatialGeom(
+        extent=64.0, cell_size=4.0, width=16, n_shards=1,
+        bucket=16, att_bucket=16, radius=4.0, mig_budget=8,
+        speed=1.0, attack_period=2,
+    )
+    rng = np.random.default_rng(1)
+    n = 300
+    world = SpatialWorld(geom)
+    world.place(
+        rng.uniform(1, 63, (n, 2)).astype(np.float32),
+        np.full(n, 100, np.int32), np.full(n, 7, np.int32),
+        (np.arange(n) % 2).astype(np.int32),
+    )
+    world.step(10)
+    got = world.gather()
+    assert len(got) == n
+    assert sum(1 for _, (_, _, h) in got.items() if h < 100) > n // 2
+    assert world.stats_last.sum() == 0
