@@ -182,6 +182,30 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+def _overflow_gauges(world) -> tuple:
+    """Run both offline overflow replays, publish them on the world's
+    telemetry registry, and read the JSON values BACK from the registry —
+    bench JSON and a /metrics scrape can never disagree."""
+    reg = world.telemetry.registry
+    g = reg.gauge(
+        "nf_bench_overflow_replay",
+        "offline cell-table overflow replay (max drops per tick)",
+        ("side",),
+    )
+    g.set(_grid_overflow_max(world), side="victim")
+    g.set(_att_overflow_max(world), side="attacker")
+    return (
+        int(reg.value("nf_bench_overflow_replay", side="victim")),
+        int(reg.value("nf_bench_overflow_replay", side="attacker")),
+    )
+
+
+def _hist_pcts(hist) -> tuple:
+    """p50/p95/p99 in ms from a registry histogram (the ONE percentile
+    implementation — telemetry.registry.Histogram.percentile)."""
+    return tuple(round(hist.percentile(p) * 1e3, 3) for p in (50, 95, 99))
+
+
 def _grid_overflow_max(world) -> int:
     """Rebuild the combat victim cell-table from the final state once
     (outside the timed region) and report entities dropped by bucket
@@ -334,12 +358,15 @@ def run_served(args) -> dict:
         jax.block_until_ready(role.kernel.state.classes["NPC"].i32)
         frame_ms.append(1000 * (time.perf_counter() - t0))
     elapsed = time.perf_counter() - t_all
-    frame_sorted = sorted(frame_ms)
-
-    def pct(p: float) -> float:
-        i = min(len(frame_sorted) - 1,
-                int(round(p / 100 * (len(frame_sorted) - 1))))
-        return round(frame_sorted[i], 3)
+    # percentiles come from the role's telemetry registry — the same
+    # histogram a /metrics scrape of this role would serve
+    frame_hist = role.telemetry.registry.histogram(
+        "nf_bench_frame_seconds", "served-path frame wall time",
+        window=max(512, args.ticks),
+    )
+    for ms in frame_ms:
+        frame_hist.observe(ms / 1e3)
+    p50, p95, p99 = _hist_pcts(frame_hist)
 
     rate = n * args.ticks / elapsed
     dev = __import__("jax").devices()[0]
@@ -353,9 +380,9 @@ def run_served(args) -> dict:
             "ticks": args.ticks,
             "sessions": n_sessions,
             "elapsed_s": round(elapsed, 4),
-            "frame_ms_p50": pct(50),
-            "frame_ms_p95": pct(95),
-            "frame_ms_p99": pct(99),
+            "frame_ms_p50": p50,
+            "frame_ms_p95": p95,
+            "frame_ms_p99": p99,
             "sync_msgs": sent["msgs"],
             "sync_bytes": sent["bytes"],
             "interest_radius": args.interest_radius,
@@ -395,6 +422,7 @@ def run_sharded(args) -> dict:
     jax.block_until_ready(k.state.classes["NPC"].i32)
     dt = time.perf_counter() - t0
     rate = n * args.ticks / dt
+    grid_drop, att_drop = _overflow_gauges(world)
     return {
         "metric": "sharded_entity_ticks_per_sec",
         "value": round(rate, 1),
@@ -411,8 +439,8 @@ def run_sharded(args) -> dict:
             "platform": jax.devices()[0].platform,
             "per_device_rate": round(rate / args.sharded, 1),
             "combat": not args.no_combat,
-            "grid_overflow_max": _grid_overflow_max(world),
-            "att_overflow_max": _att_overflow_max(world),
+            "grid_overflow_max": grid_drop,
+            "att_overflow_max": att_drop,
         },
     }
 
@@ -447,17 +475,18 @@ def run_bench(args) -> dict:
     # with a trip count of 1 — the separately-compiled _trace_step
     # program was a SECOND multi-minute 1M XLA compile that timed out
     # whole bench runs over the round-5 tunnel.
-    lat_ms: list[float] = []
+    # percentile math + sample windows live in the telemetry registry:
+    # bench JSON reads the SAME histograms a /metrics scrape would
+    reg = world.telemetry.registry
+    lat_hist = reg.histogram(
+        "nf_bench_tick_seconds", "single-dispatch tick latency"
+    )
     for _ in range(max(8, min(64, args.ticks))):
         t1 = time.perf_counter()
         k.run_device(1, reconcile=False)
         jax.block_until_ready(k.state.classes["NPC"].i32)
-        lat_ms.append(1000 * (time.perf_counter() - t1))
-    lat_sorted = sorted(lat_ms)
-
-    def pct(p: float) -> float:
-        i = min(len(lat_sorted) - 1, int(round(p / 100 * (len(lat_sorted) - 1))))
-        return round(lat_sorted[i], 3)
+        lat_hist.observe(time.perf_counter() - t1)
+    p50, p95, p99 = _hist_pcts(lat_hist)
 
     # DEVICE-honest latency: the single-step numbers above include one
     # dispatch + tunnel round trip PER TICK, which over the remote-TPU
@@ -485,18 +514,19 @@ def run_bench(args) -> dict:
     # after the loop keeps host free-lists exact.
     k.run_device(lat_k, reconcile=False)  # warm the lat_k-sized compile
     jax.block_until_ready(k.state.classes["NPC"].i32)
-    dev_ms: list[float] = []
+    dev_hist = reg.histogram(
+        "nf_bench_tick_seconds_device",
+        "fused-window per-tick latency (RTT amortised over lat_k)",
+    )
     for _ in range(n_windows):
         t1 = time.perf_counter()
         k.run_device(lat_k, reconcile=False)
         jax.block_until_ready(k.state.classes["NPC"].i32)
-        dev_ms.append(1000 * (time.perf_counter() - t1) / lat_k)
-    k.run_device(1)  # reconcile host free-lists once, outside timing
-    dev_sorted = sorted(dev_ms)
-
-    def dpct(p: float) -> float:
-        i = min(len(dev_sorted) - 1, int(round(p / 100 * (len(dev_sorted) - 1))))
-        return round(dev_sorted[i], 3)
+        dev_hist.observe((time.perf_counter() - t1) / lat_k)
+    k.tick()  # reconcile host free-lists once, outside timing; also
+    # fetches the on-device counter bank for the detail block below
+    dp50, dp95, dp99 = _hist_pcts(dev_hist)
+    grid_drop, att_drop = _overflow_gauges(world)
 
     ticks_per_s = args.ticks / dt
     rate = n * ticks_per_s
@@ -513,21 +543,23 @@ def run_bench(args) -> dict:
             "compile_and_warmup_s": round(compile_s, 2),
             "ticks_per_s": round(ticks_per_s, 2),
             "tick_ms": round(1000 * dt / args.ticks, 3),
-            "tick_ms_p50": pct(50),
-            "tick_ms_p95": pct(95),
-            "tick_ms_p99": pct(99),
+            "tick_ms_p50": p50,
+            "tick_ms_p95": p95,
+            "tick_ms_p99": p99,
             # windowed (RTT-discounted) distribution — the honest chip
             # numbers; p50 here should track tick_ms (the fused mean)
-            "tick_ms_p50_device": dpct(50),
-            "tick_ms_p95_device": dpct(95),
-            "tick_ms_p99_device": dpct(99),
+            "tick_ms_p50_device": dp50,
+            "tick_ms_p95_device": dp95,
+            "tick_ms_p99_device": dp99,
             "lat_windows": n_windows,
             "lat_k": lat_k,
             "device": str(dev),
             "platform": dev.platform,
             "combat": not args.no_combat,
-            "grid_overflow_max": _grid_overflow_max(world),
-            "att_overflow_max": _att_overflow_max(world),
+            "grid_overflow_max": grid_drop,
+            "att_overflow_max": att_drop,
+            # on-device counter bank from the reconciling tick above
+            "tick_counters": dict(k.last_counters),
         },
     }
 

@@ -382,12 +382,18 @@ class CombatModule(Module):
                 dropped_victims=jnp.broadcast_to(vic_table.dropped, (n,)),
                 dropped_attackers=jnp.broadcast_to(att_table.dropped, (n,)),
             )
+        # counter bank (rides the summary fetch; always on, unlike the
+        # emit_events-gated overflow event above)
+        ctx.count("aoi_victim_overflow_drops", vic_table.dropped)
+        ctx.count("aoi_attacker_overflow_drops", att_table.dropped)
         pulled = pull(vic_table, jnp.stack([inc, bestr], axis=-1), fill=(0, -1))
         incoming = pulled[..., 0]
         # dead-but-not-yet-respawned victims take no damage
         incoming = jnp.where(cs.alive & (hp > 0), incoming, 0)
         dmg = jnp.maximum(incoming - deff, 0)
         dmg = jnp.where(incoming > 0, jnp.maximum(dmg, 1), 0)  # a hit always chips
+        ctx.count("combat_hits", incoming > 0)
+        ctx.count("combat_damage_total", dmg)
         new_hp = jnp.maximum(hp - dmg, 0)
         i32 = cs.i32.at[:, hp_col].set(new_hp)
 
@@ -440,6 +446,7 @@ class CombatModule(Module):
         else:
             due &= False
         i32 = i32.at[:, dead_col].set(jnp.where(due, 0, i32[:, dead_col]))
+        ctx.count("respawns", due)
         if self.emit_events:
             ctx.emit(int(GameEvent.ON_NPC_RESPAWN), cname, due)
         return with_class(state, cname, cs.replace(i32=i32))

@@ -146,6 +146,12 @@ class GameWorld:
         if cfg.regen:
             self.regen = RegenModule(period_s=cfg.regen_period_s)
             modules.append(self.regen)
+        # observability: registry + tracer + census, kernel-attached via
+        # the pm lifecycle (after_init runs post kernel.build)
+        from ..telemetry import TelemetryModule
+
+        self.telemetry = TelemetryModule()
+        modules.append(self.telemetry)
 
         self._rng = np.random.default_rng(cfg.seed)
         self.pm = PluginManager(app_name="game")

@@ -19,6 +19,7 @@ each tick, fetching device data only when someone is listening.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import enum
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -76,6 +77,9 @@ class TickCtx:
         self._rng_count = 0
         self._fired = fired_masks
         self.emitted: List[DeviceEvent] = []
+        # named int32 scalars accumulated on device across phases; the
+        # kernel packs them into the per-tick summary fetch (counter bank)
+        self._counters: Dict[str, jnp.ndarray] = {}
 
     def fired(self, class_name: str, timer_name: str) -> jnp.ndarray:
         """[C] bool — which entities' `timer_name` fired this tick."""
@@ -97,6 +101,20 @@ class TickCtx:
         mask/params are traced values."""
         self.emitted.append(DeviceEvent(int(event_id), class_name, mask, dict(params)))
 
+    def count(self, name: str, value) -> None:
+        """Accumulate into the tick's on-device counter bank.  `value` is
+        any traced array — bool masks and int vectors are summed to one
+        int32 scalar.  Counters ride the packed summary vector the host
+        already fetches each tick, so observing them adds ZERO device
+        syncs; the name set is static per compilation (phases decide what
+        they count at trace time, like event metadata)."""
+        v = jnp.asarray(value)
+        if v.ndim:
+            v = jnp.sum(v, dtype=jnp.int32)
+        v = v.astype(jnp.int32)
+        prev = self._counters.get(name)
+        self._counters[name] = v if prev is None else prev + v
+
 
 @dataclasses.dataclass
 class TickOutputs:
@@ -115,6 +133,10 @@ class TickOutputs:
         default_factory=dict
     )
     rec_diff_count: Dict[str, jnp.ndarray] = dataclasses.field(default_factory=dict)
+    # counter bank decoded from the summary fetch: name -> host int
+    # (events fired, diff cells, deaths, combat hits, AOI overflow drops
+    # + anything phases ctx.count()ed) — already on host, free to read
+    counters: Dict[str, int] = dataclasses.field(default_factory=dict)
 
 
 class Kernel(Module):
@@ -158,6 +180,14 @@ class Kernel(Module):
         self._pending_destroy: List[Guid] = []
         self._event_meta: List[Tuple[int, str, Tuple[str, ...]]] = []
         self.tick_count = 0
+        # counter-bank decode order, captured at trace time like
+        # _event_meta (static per compilation)
+        self._counter_names: Tuple[str, ...] = ()
+        self.last_counters: Dict[str, int] = {}  # latest observed tick
+        self.counter_totals: Dict[str, int] = {}  # cumulative over tick()s
+        # optional telemetry.SpanTracer for host-side tick stage spans
+        # (dispatch / summary fetch / post-tick fan-out); None = no cost
+        self.tracer = None
 
     # -- build --------------------------------------------------------------
 
@@ -200,16 +230,21 @@ class Kernel(Module):
         old = state
         fired: Dict[str, jnp.ndarray] = {}
         new_classes = {}
-        for cname in self.store.class_order:
-            cs, f = self.schedule.advance_class(state.classes[cname], state.tick)
-            new_classes[cname] = cs
-            fired[cname] = f
-        state = state.replace(classes=new_classes)
+        # per-stage named scopes ride the HLO metadata: an XProf/profiler
+        # capture attributes device time to "nf.schedule", "nf.phase.*",
+        # "nf.diff" instead of one opaque fused computation
+        with jax.named_scope("nf.schedule"):
+            for cname in self.store.class_order:
+                cs, f = self.schedule.advance_class(state.classes[cname], state.tick)
+                new_classes[cname] = cs
+                fired[cname] = f
+            state = state.replace(classes=new_classes)
 
         rng = jax.random.fold_in(state.rng, state.tick)
         ctx = TickCtx(self, state.tick, rng, fired)
         for phase in self._composed:
-            state = phase.fn(state, ctx)
+            with jax.named_scope(f"nf.phase.{phase.name}"):
+                state = phase.fn(state, ctx)
 
         diff: Dict[str, Dict[str, jnp.ndarray]] = {}
         diff_count: Dict[str, jnp.ndarray] = {}
@@ -217,82 +252,95 @@ class Kernel(Module):
         rec_diff_count: Dict[str, jnp.ndarray] = {}
         died: Dict[str, jnp.ndarray] = {}
         died_count: Dict[str, jnp.ndarray] = {}
-        for cname in self.store.class_order:
-            spec = self.store.spec(cname)
-            oc, nc = old.classes[cname], state.classes[cname]
-            masks: Dict[str, jnp.ndarray] = {}
-            total = jnp.zeros((), jnp.int32)
-            flag_union = {}
-            for bank, nm in ((Bank.I32, "i32"), (Bank.F32, "f32"), (Bank.VEC, "vec")):
-                fm = np.zeros(spec.bank_size(bank), bool)
-                for fl in self._diff_flags:
-                    fm |= spec.mask(bank, fl)
-                for pname in self._forced_diff.get(cname, ()):
-                    slot = spec.slot(pname)
-                    if slot.bank == bank:
-                        fm[slot.col] = True
-                flag_union[nm] = fm
-            if flag_union["i32"].any():
-                m = (oc.i32 != nc.i32) & nc.alive[:, None] & flag_union["i32"][None, :]
-                masks["i32"] = m
-                total = total + jnp.sum(m, dtype=jnp.int32)
-            if flag_union["f32"].any():
-                m = (oc.f32 != nc.f32) & nc.alive[:, None] & flag_union["f32"][None, :]
-                masks["f32"] = m
-                total = total + jnp.sum(m, dtype=jnp.int32)
-            if flag_union["vec"].any():
-                m = (
-                    jnp.any(oc.vec != nc.vec, axis=-1)
-                    & nc.alive[:, None]
-                    & flag_union["vec"][None, :]
-                )
-                masks["vec"] = m
-                total = total + jnp.sum(m, dtype=jnp.int32)
-            if masks:
-                diff[cname] = masks
-                diff_count[cname] = total
-            # record-row diffs: add/remove/update codes per (entity, row),
-            # only for subscribed records (device phases mutate records —
-            # buff expiry, stat groups — and those changes must reach the
-            # same sync spine as host record ops;
-            # reference NFCRecord per-op callbacks, NFCRecord.h:17-156)
-            rec_codes: Dict[str, jnp.ndarray] = {}
-            rec_total = jnp.zeros((), jnp.int32)
-            for rname in spec.record_order:
-                if (cname, rname) not in self._rec_event_subs:
-                    continue
-                rs = spec.records[rname]
-                orec, nrec = oc.records[rname], nc.records[rname]
-                cell_changed = jnp.zeros(nrec.used.shape, bool)
-                if rs.n_i32:
-                    cell_changed |= jnp.any(orec.i32 != nrec.i32, axis=-1)
-                if rs.n_f32:
-                    cell_changed |= jnp.any(orec.f32 != nrec.f32, axis=-1)
-                if rs.n_vec:
-                    cell_changed |= jnp.any(orec.vec != nrec.vec, axis=(-2, -1))
-                code = jnp.where(
-                    ~orec.used & nrec.used,
-                    REC_ADDED,
-                    jnp.where(
-                        orec.used & ~nrec.used,
-                        REC_REMOVED,
-                        jnp.where(nrec.used & cell_changed, REC_UPDATED, REC_NONE),
-                    ),
-                ).astype(jnp.int8)
-                code = code * nc.alive[:, None].astype(jnp.int8)
-                rec_codes[rname] = code
-                rec_total = rec_total + jnp.sum(code != 0, dtype=jnp.int32)
-            if rec_codes:
-                rec_diff[cname] = rec_codes
-                rec_diff_count[cname] = rec_total
-            d = oc.alive & ~nc.alive
-            died[cname] = d
-            died_count[cname] = jnp.sum(d, dtype=jnp.int32)
+        with jax.named_scope("nf.diff"):
+            for cname in self.store.class_order:
+                spec = self.store.spec(cname)
+                oc, nc = old.classes[cname], state.classes[cname]
+                masks: Dict[str, jnp.ndarray] = {}
+                total = jnp.zeros((), jnp.int32)
+                flag_union = {}
+                for bank, nm in ((Bank.I32, "i32"), (Bank.F32, "f32"), (Bank.VEC, "vec")):
+                    fm = np.zeros(spec.bank_size(bank), bool)
+                    for fl in self._diff_flags:
+                        fm |= spec.mask(bank, fl)
+                    for pname in self._forced_diff.get(cname, ()):
+                        slot = spec.slot(pname)
+                        if slot.bank == bank:
+                            fm[slot.col] = True
+                    flag_union[nm] = fm
+                if flag_union["i32"].any():
+                    m = (oc.i32 != nc.i32) & nc.alive[:, None] & flag_union["i32"][None, :]
+                    masks["i32"] = m
+                    total = total + jnp.sum(m, dtype=jnp.int32)
+                if flag_union["f32"].any():
+                    m = (oc.f32 != nc.f32) & nc.alive[:, None] & flag_union["f32"][None, :]
+                    masks["f32"] = m
+                    total = total + jnp.sum(m, dtype=jnp.int32)
+                if flag_union["vec"].any():
+                    m = (
+                        jnp.any(oc.vec != nc.vec, axis=-1)
+                        & nc.alive[:, None]
+                        & flag_union["vec"][None, :]
+                    )
+                    masks["vec"] = m
+                    total = total + jnp.sum(m, dtype=jnp.int32)
+                if masks:
+                    diff[cname] = masks
+                    diff_count[cname] = total
+                # record-row diffs: add/remove/update codes per (entity, row),
+                # only for subscribed records (device phases mutate records —
+                # buff expiry, stat groups — and those changes must reach the
+                # same sync spine as host record ops;
+                # reference NFCRecord per-op callbacks, NFCRecord.h:17-156)
+                rec_codes: Dict[str, jnp.ndarray] = {}
+                rec_total = jnp.zeros((), jnp.int32)
+                for rname in spec.record_order:
+                    if (cname, rname) not in self._rec_event_subs:
+                        continue
+                    rs = spec.records[rname]
+                    orec, nrec = oc.records[rname], nc.records[rname]
+                    cell_changed = jnp.zeros(nrec.used.shape, bool)
+                    if rs.n_i32:
+                        cell_changed |= jnp.any(orec.i32 != nrec.i32, axis=-1)
+                    if rs.n_f32:
+                        cell_changed |= jnp.any(orec.f32 != nrec.f32, axis=-1)
+                    if rs.n_vec:
+                        cell_changed |= jnp.any(orec.vec != nrec.vec, axis=(-2, -1))
+                    code = jnp.where(
+                        ~orec.used & nrec.used,
+                        REC_ADDED,
+                        jnp.where(
+                            orec.used & ~nrec.used,
+                            REC_REMOVED,
+                            jnp.where(nrec.used & cell_changed, REC_UPDATED, REC_NONE),
+                        ),
+                    ).astype(jnp.int8)
+                    code = code * nc.alive[:, None].astype(jnp.int8)
+                    rec_codes[rname] = code
+                    rec_total = rec_total + jnp.sum(code != 0, dtype=jnp.int32)
+                if rec_codes:
+                    rec_diff[cname] = rec_codes
+                    rec_diff_count[cname] = rec_total
+                d = oc.alive & ~nc.alive
+                died[cname] = d
+                died_count[cname] = jnp.sum(d, dtype=jnp.int32)
 
         state = state.replace(tick=state.tick + 1)
         # static event metadata is captured on self at trace time; only the
         # traced arrays cross the jit boundary (dataclasses aren't pytrees)
         self._event_meta = [(e.event_id, e.class_name, tuple(e.params)) for e in ctx.emitted]
+        # on-device counter bank: phase-accumulated ctx.count() values plus
+        # kernel builtins.  Names are static per compilation (same contract
+        # as _event_meta); values ride the summary fetch below, so the
+        # telemetry surface costs ZERO extra device syncs per tick.
+        ev_counts = [jnp.sum(e.mask, dtype=jnp.int32) for e in ctx.emitted]
+        counters = dict(ctx._counters)
+        zero = jnp.zeros((), jnp.int32)
+        counters["deaths"] = sum(died_count.values(), zero)
+        counters["diff_cells"] = sum(diff_count.values(), zero)
+        counters["rec_diff_cells"] = sum(rec_diff_count.values(), zero)
+        counters["events_fired"] = sum(ev_counts, zero)
+        self._counter_names = tuple(sorted(counters))
         # ONE packed scalar vector per tick — the only thing the host ever
         # synchronously fetches.  Anything else (masks, params, fired) is
         # fetched lazily and only when this summary says there's something
@@ -309,11 +357,10 @@ class Kernel(Module):
                 jnp.stack([rec_diff_count[c] for c in sorted(rec_diff_count)])
                 if rec_diff_count
                 else jnp.zeros((0,), jnp.int32),
-                jnp.stack(
-                    [jnp.sum(e.mask, dtype=jnp.int32) for e in ctx.emitted]
-                )
+                jnp.stack(ev_counts)
                 if ctx.emitted
                 else jnp.zeros((0,), jnp.int32),
+                jnp.stack([counters[k] for k in self._counter_names]),
             ]
         )
         out = {
@@ -340,10 +387,17 @@ class Kernel(Module):
         self._jit_step = None
         self._jit_run = None
 
+    def _span(self, name: str):
+        """Host-side tracer span if a tracer is attached, else free."""
+        if self.tracer is not None:
+            return self.tracer.span(name)
+        return contextlib.nullcontext()
+
     def tick(self) -> TickOutputs:
         """Advance the world one frame and fan out host-visible effects."""
         self.compile()
-        self.state, raw = self._jit_step(self.state)
+        with self._span("kernel.dispatch"):
+            self.state, raw = self._jit_step(self.state)
         self.tick_count += 1
         out = TickOutputs(
             fired=raw["fired"],
@@ -360,7 +414,19 @@ class Kernel(Module):
                 )
             ],
         )
-        self._post_tick(out, np.asarray(raw["summary"]))
+        with self._span("kernel.summary_fetch"):
+            summary = np.asarray(raw["summary"])
+        # decode the counter bank from the summary tail (names captured at
+        # trace time, same static-metadata contract as _event_meta)
+        names = self._counter_names
+        if names:
+            tail = summary[len(summary) - len(names):]
+            out.counters = {k: int(v) for k, v in zip(names, tail)}
+            self.last_counters = dict(out.counters)
+            for k, v in out.counters.items():
+                self.counter_totals[k] = self.counter_totals.get(k, 0) + v
+        with self._span("kernel.post_tick"):
+            self._post_tick(out, summary)
         return out
 
     def run_device(self, n: int, reconcile: bool = True) -> int:
@@ -410,7 +476,10 @@ class Kernel(Module):
         off = n_cls + len(diff_keys)
         rec_keys = sorted(out.rec_diff_count)
         rec_counts = dict(zip(rec_keys, summary[off : off + len(rec_keys)]))
-        event_counts = summary[off + len(rec_keys) :]
+        off2 = off + len(rec_keys)
+        # bounded slice: the on-device counter bank rides AFTER the event
+        # counts, so an open-ended slice would absorb it
+        event_counts = summary[off2 : off2 + len(out.events)]
         # device-emitted events FIRST — entities that died this tick must
         # still deliver their events (the reference fires events before
         # destroy), so guid identities are intact here

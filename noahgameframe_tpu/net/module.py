@@ -29,6 +29,27 @@ ReceiveHandler = Callable[[int, int, bytes], None]  # (conn_id, msg_id, body)
 EventHandler = Callable[[int, int], None]  # (conn_id, event_kind)
 
 
+class NetCounters:
+    """Per-opcode message/byte counters for one endpoint (both
+    directions).  Plain dicts keyed by msg_id — sampled lazily by
+    telemetry's ``nf_net_msgs_total`` / ``nf_net_bytes_total`` callbacks,
+    so the hot send/receive path pays two dict bumps and nothing else."""
+
+    def __init__(self) -> None:
+        self.in_msgs: Dict[int, int] = {}
+        self.in_bytes: Dict[int, int] = {}
+        self.out_msgs: Dict[int, int] = {}
+        self.out_bytes: Dict[int, int] = {}
+
+    def count_in(self, msg_id: int, nbytes: int) -> None:
+        self.in_msgs[msg_id] = self.in_msgs.get(msg_id, 0) + 1
+        self.in_bytes[msg_id] = self.in_bytes.get(msg_id, 0) + nbytes
+
+    def count_out(self, msg_id: int, nbytes: int) -> None:
+        self.out_msgs[msg_id] = self.out_msgs.get(msg_id, 0) + 1
+        self.out_bytes[msg_id] = self.out_bytes.get(msg_id, 0) + nbytes
+
+
 class _Dispatch:
     """msgID -> handler fan-out with per-message fault isolation.
 
@@ -38,12 +59,13 @@ class _Dispatch:
     (NFINetModule::OnReceiveNetPack, NFINetModule.h:473-520).  Each
     handler call is isolated; failures are logged and counted."""
 
-    def __init__(self) -> None:
+    def __init__(self, counters: Optional[NetCounters] = None) -> None:
         self._handlers: Dict[int, List[ReceiveHandler]] = {}
         self._default: List[ReceiveHandler] = []
         self._events: List[EventHandler] = []
         self._log = logging.getLogger("nf.net.dispatch")
         self.dropped_msgs = 0  # observability: handler faults survived
+        self.counters = counters
 
     def on(self, msg_id: int, fn: ReceiveHandler) -> None:
         self._handlers.setdefault(int(msg_id), []).append(fn)
@@ -68,6 +90,8 @@ class _Dispatch:
     def feed(self, events: List[NetEvent]) -> None:
         for ev in events:
             if ev.kind == EV_MSG:
+                if self.counters is not None:
+                    self.counters.count_in(ev.msg_id, len(ev.body))
                 fns = self._handlers.get(ev.msg_id)
                 if fns:
                     for fn in fns:
@@ -95,7 +119,8 @@ class NetServerModule:
         self.transport = create_server(host, port, backend=backend)
         self.host = host
         self.port = self.transport.port
-        self.dispatch = _Dispatch()
+        self.counters = NetCounters()
+        self.dispatch = _Dispatch(counters=self.counters)
         # connection tags, mirroring NetObject's account/id binding
         # (`NFINet.h:246-405`): conn_id -> dict of app tags
         self.conn_tags: Dict[int, Dict[str, object]] = {}
@@ -119,7 +144,10 @@ class NetServerModule:
 
     # ------------------------------------------------------------ send
     def send_raw(self, conn_id: int, msg_id: int, body: bytes) -> bool:
-        return self.transport.send(conn_id, msg_id, body)
+        ok = self.transport.send(conn_id, msg_id, body)
+        if ok:
+            self.counters.count_out(msg_id, len(body))
+        return ok
 
     def send_pb(self, conn_id: int, msg_id: int, msg: Message,
                 player_id: Optional[Ident] = None,
@@ -129,7 +157,7 @@ class NetServerModule:
             msg_data=msg.encode(),
             player_client_list=clients or [],
         )
-        return self.transport.send(conn_id, msg_id, env.encode())
+        return self.send_raw(conn_id, msg_id, env.encode())
 
     def broadcast_pb(self, msg_id: int, msg: Message,
                      player_id: Optional[Ident] = None) -> None:
@@ -177,7 +205,8 @@ class NetClientModule:
         self._backend = backend
         self.servers: Dict[int, ServerData] = {}
         self.ring: ConsistentHash[int] = ConsistentHash()
-        self.dispatch = _Dispatch()
+        self.counters = NetCounters()
+        self.dispatch = _Dispatch(counters=self.counters)
         self.reconnect_seconds = reconnect_seconds
         self.keepalive_seconds = keepalive_seconds
         self._last_keepalive = 0.0
@@ -221,7 +250,10 @@ class NetClientModule:
         sd = self.servers.get(server_id)
         if sd is None or sd.state != NORMAL:
             return False
-        return sd.client.send_msg(msg_id, body)
+        ok = sd.client.send_msg(msg_id, body)
+        if ok:
+            self.counters.count_out(msg_id, len(body))
+        return ok
 
     def send_pb_by_server_id(self, server_id: int, msg_id: int, msg: Message,
                              player_id: Optional[Ident] = None,

@@ -94,12 +94,26 @@ class ServerRole:
         self.backend = backend
         self.clients: Dict[str, NetClientModule] = {}
         self.state = int(ServerState.NORMAL)
+        # telemetry: one registry per role.  A role that owns a world
+        # (GameRole sets self.game_world before super().__init__) adopts
+        # the world's TelemetryModule so /metrics includes the kernel's
+        # counter bank alongside role/net metrics — ONE registry, never
+        # two disagreeing ones.
+        from ...telemetry import TelemetryModule
+
+        gw = getattr(self, "game_world", None)
+        tel = getattr(gw, "telemetry", None)
+        self.telemetry: TelemetryModule = (
+            tel if tel is not None else TelemetryModule()
+        )
         # frame-latency window; run_role's loop (and any operator pump)
         # wraps role.execute in metrics.frame() — percentiles ride the
-        # 10 s report's ext map up to the master dashboard
-        from ...utils.metrics import TickMetrics
-
-        self.metrics = TickMetrics()
+        # 10 s report's ext map up to the master dashboard AND the
+        # nf_frame_seconds histogram on /metrics (same samples)
+        self.metrics = self.telemetry.tick
+        self.telemetry.attach_role(self)
+        self.telemetry.attach_kernel(getattr(self, "kernel", None))
+        self._metrics_http = None
         self._install()
 
     # hook for subclasses to register handlers
@@ -126,7 +140,23 @@ class ServerRole:
                 lambda: pool.send_to_all(int(refresh_msg), wrap(self.report_list()))
             )
         self.clients[key] = pool
+        self.telemetry.add_net_source(key, pool.counters)
         return pool
+
+    def serve_metrics(self, port: int = 0,
+                      host: Optional[str] = None):
+        """Expose /metrics on a dedicated HttpServer (for roles without a
+        status server; Master mounts onto its existing /json server
+        instead).  Pumped from execute(); returns the server (inspect
+        ``.port`` when asking for an ephemeral one)."""
+        if self._metrics_http is None:
+            from ..http import HttpServer
+
+            self._metrics_http = HttpServer(
+                host if host is not None else self.config.ip, port
+            )
+            self.telemetry.mount(self._metrics_http)
+        return self._metrics_http
 
     def cur_count(self) -> int:
         """Load metric reported upstream; roles override (players online,
@@ -166,6 +196,8 @@ class ServerRole:
         self.server.execute()
         for pool in self.clients.values():
             pool.execute(now)
+        if self._metrics_http is not None:
+            self._metrics_http.execute()
 
     def run(self, seconds: float, sleep: float = 0.001) -> None:
         end = _time.monotonic() + seconds
@@ -177,6 +209,9 @@ class ServerRole:
         self.server.shut()
         for pool in self.clients.values():
             pool.shut()
+        if self._metrics_http is not None:
+            self._metrics_http.close()
+            self._metrics_http = None
 
 
 def decode_reports(body: bytes) -> List[ServerInfoReport]:

@@ -196,6 +196,12 @@ class GameRole(ServerRole):
         self.autosave_seconds = autosave_seconds
         self._last_autosave = 0.0
         super().__init__(config, backend=backend)
+        # world-tick latency, separate from the pump's frame histogram
+        # (a pump frame with no tick due is ~free; mixing them would
+        # drown the tick percentiles in poll noise)
+        self._tick_hist = self.telemetry.registry.histogram(
+            "nf_game_tick_seconds", "world tick latency (kernel + modules)"
+        )
         self.world_link = self.add_upstream(
             "world",
             [t for t in config.targets if t.server_type == int(ServerType.WORLD)],
@@ -1259,21 +1265,25 @@ class GameRole(ServerRole):
         pm = self.game_world.pm
         if now - self._last_tick >= self.game_world.config.dt:
             self._last_tick = now
-            for m in pm.modules.values():
-                if m is not self.kernel:
-                    m.execute()
-            self.kernel.execute()
-            self.kernel.tick()
-            pm.frame += 1
+            with self.telemetry.tracer.span("game.tick"):
+                t0 = _time.perf_counter()
+                for m in pm.modules.values():
+                    if m is not self.kernel:
+                        m.execute()
+                self.kernel.execute()
+                self.kernel.tick()
+                pm.frame += 1
+                self._tick_hist.observe(_time.perf_counter() - t0)
         # _interest_dirty alone must also trigger a flush: a destroy with
         # no property diff still changes visible sets (gone lists)
         if self._changed or self._rec_changed or self._interest_dirty:
-            if self.sessions:
-                self._flush_changes()
-            else:
-                self._changed.clear()
-                self._rec_changed.clear()
-                self._interest_dirty.clear()
+            with self.telemetry.tracer.span("game.flush"):
+                if self.sessions:
+                    self._flush_changes()
+                else:
+                    self._changed.clear()
+                    self._rec_changed.clear()
+                    self._interest_dirty.clear()
         # periodic autosave: device-side deaths free the row before any
         # BEFORE_DESTROY hook can run, so the blob must already be fresh
         if (self.data_agent is not None

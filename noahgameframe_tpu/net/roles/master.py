@@ -54,6 +54,8 @@ class MasterRole(ServerRole):
             self.http = HttpServer(config.ip, http_port)
             self.http.route("/json", lambda _p, _q: self.servers_status())
             self.http.route("/", self._index_page)
+            # Prometheus exposition rides the same status server
+            self.telemetry.mount(self.http)
 
     def _install(self) -> None:
         s = self.server

@@ -1,0 +1,40 @@
+"""Telemetry: metrics registry, span tracing, and role wiring.
+
+The reference's observability is a web monitor plus a memory census with
+performance tracking disabled in every shipped conf (SURVEY §5).  This
+package is its replacement for the TPU port, in three layers:
+
+- :mod:`registry` — a Prometheus-style counter/gauge/histogram registry
+  with text exposition, mounted at ``/metrics`` on any role's
+  :class:`~noahgameframe_tpu.net.http.HttpServer`.
+- :mod:`tracing` — a host-side ring-buffer span tracer with Chrome
+  trace-event JSON export (open in Perfetto), complementing the
+  ``jax.named_scope`` stage annotations inside the compiled tick
+  (visible in XProf device timelines).
+- :mod:`module` — :class:`TelemetryModule`, the one wiring point: it
+  binds the kernel's on-device counter bank, the frame-latency
+  histogram, the memory census, and per-opcode net counters into one
+  registry per role/world.
+"""
+
+from .registry import (
+    CallbackMetric,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    escape_label_value,
+)
+from .tracing import SpanTracer
+from .module import TelemetryModule
+
+__all__ = [
+    "CallbackMetric",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SpanTracer",
+    "TelemetryModule",
+    "escape_label_value",
+]

@@ -1,0 +1,174 @@
+"""TelemetryModule: one registry per role/world, every source wired in.
+
+Sources absorbed (all sampled lazily at scrape time — a role that nobody
+scrapes pays nothing per frame):
+
+- frame latency: :class:`~noahgameframe_tpu.utils.metrics.TickMetrics`
+  observing into a registry-owned histogram (``nf_frame_seconds``), plus
+  precomputed quantile gauges (``nf_frame_latency_ms``) so dashboards
+  don't need server-side histogram math;
+- the kernel's ON-DEVICE counter bank (``nf_tick_counters_total`` /
+  ``nf_tick_counters``): events fired, diff cells, deaths, combat hits,
+  AOI/stencil overflow drops — accumulated inside the jitted tick and
+  decoded from the summary vector the host already fetches (zero extra
+  device syncs; kernel/kernel.py);
+- per-opcode net counters (``nf_net_msgs_total`` / ``nf_net_bytes_total``
+  with direction/link/opcode labels) from every NetServerModule /
+  NetClientModule pool the role owns;
+- the memory census (``nf_census`` per kind, ``nf_device_bytes``) and
+  per-class live-entity gauges.
+
+``mount(http)`` exposes the registry at ``/metrics`` on any
+net/http.py HttpServer; ServerRole.serve_metrics() spins up a dedicated
+one for roles without a status server.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Tuple
+
+from ..kernel.module import Module
+from .registry import MetricsRegistry, CONTENT_TYPE  # noqa: F401
+from .tracing import SpanTracer
+
+
+class TelemetryModule(Module):
+    name = "TelemetryModule"
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 window: int = 512) -> None:
+        super().__init__()
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.tracer = SpanTracer(enabled=False)
+        # import here: utils.metrics imports telemetry.registry
+        from ..utils.metrics import MemoryCensus, TickMetrics
+
+        self.tick = TickMetrics(
+            window=window,
+            histogram=self.registry.histogram(
+                "nf_frame_seconds", "main-loop frame latency (seconds)",
+                window=window,
+            ),
+        )
+        self.census = MemoryCensus()
+        self._net_sources: Dict[str, object] = {}
+        self._kernel_attached = False
+        self._role_attached = False
+        self.registry.register_callback(
+            "nf_frame_latency_ms", self._frame_quantiles, kind="gauge",
+            help="frame latency quantiles in ms (exact, window-based)",
+        )
+        self.registry.register_callback(
+            "nf_net_msgs_total", lambda: self._net_samples(0),
+            kind="counter", help="messages per link/direction/opcode",
+        )
+        self.registry.register_callback(
+            "nf_net_bytes_total", lambda: self._net_samples(1),
+            kind="counter", help="payload bytes per link/direction/opcode",
+        )
+
+    # ------------------------------------------------------------ sources
+    def _frame_quantiles(self) -> Iterable[Tuple[dict, float]]:
+        h = self.tick.hist
+        for q in (50, 95, 99):
+            yield ({"quantile": f"p{q}"}, h.percentile(q) * 1e3)
+
+    def add_net_source(self, link: str, counters) -> None:
+        """Register a NetCounters (net/module.py) under a link label."""
+        self._net_sources[str(link)] = counters
+
+    def _net_samples(self, which: int) -> Iterable[Tuple[dict, float]]:
+        for link, c in sorted(self._net_sources.items()):
+            for direction, d in (
+                ("in", (c.in_msgs, c.in_bytes)[which]),
+                ("out", (c.out_msgs, c.out_bytes)[which]),
+            ):
+                for opcode in sorted(d):
+                    yield (
+                        {"link": link, "direction": direction,
+                         "opcode": str(opcode)},
+                        d[opcode],
+                    )
+
+    def attach_role(self, role) -> None:
+        """Wire a ServerRole: identity gauge + its net counter sources.
+        (Frame timing attaches by the role adopting ``self.tick``.)"""
+        if self._role_attached:
+            return
+        self._role_attached = True
+        info = self.registry.gauge(
+            "nf_role_info", "role identity (value is always 1)",
+            ("role", "server_id"),
+        )
+        info.set(1, role=type(role).__name__,
+                 server_id=str(role.config.server_id))
+        self.add_net_source("server", role.server.counters)
+
+    def attach_kernel(self, kernel) -> None:
+        """Wire a Kernel: counter bank, tick count, entities, census."""
+        if self._kernel_attached or kernel is None:
+            return
+        self._kernel_attached = True
+        self.census.kernel = kernel
+        kernel.tracer = self.tracer
+        reg = self.registry
+        reg.register_callback(
+            "nf_ticks_total", lambda: kernel.tick_count, kind="counter",
+            help="world ticks advanced (tick + run_device)",
+        )
+        reg.register_callback(
+            "nf_tick_counters_total",
+            lambda: (
+                ({"counter": k}, v)
+                for k, v in sorted(kernel.counter_totals.items())
+            ),
+            kind="counter",
+            help="on-device counter bank, cumulative over observed ticks",
+        )
+        reg.register_callback(
+            "nf_tick_counters",
+            lambda: (
+                ({"counter": k}, v)
+                for k, v in sorted(kernel.last_counters.items())
+            ),
+            kind="gauge",
+            help="on-device counter bank, last observed tick",
+        )
+        reg.register_callback(
+            "nf_entities_live",
+            lambda: (
+                ({"class": c}, kernel.store.live_count(c))
+                for c in kernel.store.class_order
+            )
+            if kernel.store is not None
+            else (),
+            kind="gauge", help="live entity rows per class",
+        )
+        reg.register_callback(
+            "nf_census",
+            lambda: (
+                ({"kind": k}, v) for k, v in sorted(self.census.census().items())
+            ),
+            kind="gauge", help="memory census: live objects per kind",
+        )
+        reg.register_callback(
+            "nf_device_bytes", self.census.device_bytes, kind="gauge",
+            help="bytes held by live device arrays (best effort)",
+        )
+
+    # ------------------------------------------------- module lifecycle
+    def after_init(self) -> None:
+        # when registered in a world's PluginManager the kernel is bound
+        # by now (pm runs after_init post kernel.build)
+        self.attach_kernel(self.kernel)
+        if self.census.log_module is None and self.kernel is not None:
+            # discover a LogModule sibling for census probe failures
+            pass
+
+    # ------------------------------------------------------------ expose
+    def mount(self, http) -> None:
+        """Route /metrics on an existing HttpServer."""
+        http.route("/metrics", self.registry.handler)
+
+    def exposition(self) -> str:
+        return self.registry.exposition()

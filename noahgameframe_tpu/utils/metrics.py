@@ -1,19 +1,24 @@
 """Per-tick metrics + profiler hooks — the observability the reference
 lacks (SURVEY §5: easylogging's PERFORMANCE_TRACKING is disabled in every
 conf; the TPU build replaces it with real timing + JAX profiler traces).
+
+The timing window and ALL percentile math live in
+:class:`~noahgameframe_tpu.telemetry.registry.Histogram` — TickMetrics
+is a thin frame-timing facade over one histogram instance, so the role
+report, the bench JSON and a ``/metrics`` scrape read the same numbers
+from the same samples.
 """
 
 from __future__ import annotations
 
-import collections
 import contextlib
 import json
+import logging
 import time
-from typing import Deque, Dict, Optional
-
-import numpy as np
+from typing import Dict, Optional
 
 from ..kernel.module import Module
+from ..telemetry.registry import Histogram
 
 
 class TickMetrics(Module):
@@ -22,12 +27,22 @@ class TickMetrics(Module):
 
     name = "TickMetrics"
 
-    def __init__(self, window: int = 512) -> None:
+    def __init__(self, window: int = 512,
+                 histogram: Optional[Histogram] = None) -> None:
         super().__init__()
         self.window = window
-        self._durations: Deque[float] = collections.deque(maxlen=window)
+        # the histogram owns the sample window AND the percentile math;
+        # pass a registry-owned instance to surface frames on /metrics
+        self.hist = histogram if histogram is not None else Histogram(
+            "nf_frame_seconds", "main-loop frame latency", window=window
+        )
         self._t0: Optional[float] = None
         self.frames = 0
+
+    @property
+    def _durations(self):
+        """The raw window in seconds (compat view; the histogram owns it)."""
+        return self.hist.window_values()
 
     # call around the tick (world/role loops use the context wrapper)
     def frame_start(self) -> None:
@@ -39,7 +54,7 @@ class TickMetrics(Module):
         dt = time.perf_counter() - self._t0
         self._t0 = None
         self.frames += 1
-        self._durations.append(dt)
+        self.hist.observe(dt)
 
     @contextlib.contextmanager
     def frame(self):
@@ -50,16 +65,19 @@ class TickMetrics(Module):
             self.frame_end()
 
     # -- aggregates ------------------------------------------------------
+    def _mean_s(self) -> float:
+        """One mean, one place: every consumer below routes through it."""
+        return self.hist.window_mean()
+
     def percentiles(self) -> Dict[str, float]:
-        if not self._durations:
+        if not self.hist.count:
             return {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0,
                     "mean_ms": 0.0}
-        a = np.asarray(self._durations) * 1e3
         return {
-            "p50_ms": float(np.percentile(a, 50)),
-            "p95_ms": float(np.percentile(a, 95)),
-            "p99_ms": float(np.percentile(a, 99)),
-            "mean_ms": float(a.mean()),
+            "p50_ms": self.hist.percentile(50) * 1e3,
+            "p95_ms": self.hist.percentile(95) * 1e3,
+            "p99_ms": self.hist.percentile(99) * 1e3,
+            "mean_ms": self._mean_s() * 1e3,
         }
 
     def live_entities(self) -> int:
@@ -71,19 +89,14 @@ class TickMetrics(Module):
         )
 
     def entities_per_second(self) -> float:
-        if not self._durations:
-            return 0.0
-        mean_s = float(np.mean(self._durations))
+        mean_s = self._mean_s()
         return self.live_entities() / mean_s if mean_s > 0 else 0.0
 
     def snapshot(self) -> Dict[str, float]:
         out = dict(self.percentiles())
         out["frames"] = self.frames
-        live = self.live_entities()
-        mean_s = (float(np.mean(self._durations))
-                  if self._durations else 0.0)
-        out["entities_per_s"] = live / mean_s if mean_s > 0 else 0.0
-        out["live"] = live
+        out["entities_per_s"] = self.entities_per_second()
+        out["live"] = self.live_entities()
         return out
 
     def json_line(self) -> str:
@@ -101,13 +114,30 @@ class MemoryCensus(Module):
 
     name = "MemoryCensus"
 
-    def __init__(self) -> None:
+    def __init__(self, log_module=None) -> None:
         super().__init__()
         self._probes: Dict[str, object] = {}
+        # a probe that throws reports -1 but must not stay silent: each
+        # failing kind is logged ONCE (LogModule when attached, stdlib
+        # logger otherwise) so dead probes are discoverable in ops logs
+        self.log_module = log_module
+        self._failed_probes: set = set()
 
     def register_probe(self, kind: str, fn) -> None:
         """fn() -> int live count for a host-side object kind."""
         self._probes[kind] = fn
+        self._failed_probes.discard(kind)
+
+    def _log_probe_failure(self, kind: str, exc: Exception) -> None:
+        if kind in self._failed_probes:
+            return
+        self._failed_probes.add(kind)
+        msg = "memory census probe %r failed (reporting -1): %s: %s"
+        args = (kind, type(exc).__name__, exc)
+        if self.log_module is not None:
+            self.log_module.warning(msg, *args)
+        else:
+            logging.getLogger("nf.metrics").warning(msg, *args)
 
     def census(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
@@ -117,7 +147,8 @@ class MemoryCensus(Module):
         for kind, fn in self._probes.items():
             try:
                 out[kind] = int(fn())
-            except Exception:  # noqa: BLE001 — census must never throw
+            except Exception as e:  # noqa: BLE001 — census must never throw
+                self._log_probe_failure(kind, e)
                 out[kind] = -1
         return out
 
@@ -143,7 +174,8 @@ class MemoryCensus(Module):
 @contextlib.contextmanager
 def profiler_trace(log_dir: str):
     """JAX profiler capture around a block — open the result with
-    TensorBoard/XProf to see the compiled tick's device timeline."""
+    TensorBoard/XProf to see the compiled tick's device timeline (the
+    per-stage ``jax.named_scope`` names from Kernel._trace_step)."""
     import jax
 
     jax.profiler.start_trace(log_dir)

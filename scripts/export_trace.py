@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Capture a Chrome trace-event JSON of a benchmark world run.
+
+    JAX_PLATFORMS=cpu python scripts/export_trace.py --ticks 100 \
+        --out /tmp/nf_trace.json
+
+Open the result in Perfetto (https://ui.perfetto.dev) or
+chrome://tracing.  The host-side spans come from the SpanTracer the
+kernel dispatch/fetch/post stages record into
+(telemetry/tracing.py); for the DEVICE timeline use --xprof DIR
+instead, which wraps the run in a JAX profiler capture whose HLO ops
+carry the per-stage jax.named_scope names (nf.schedule, nf.phase.*,
+nf.diff) for XProf/TensorBoard.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--entities", type=int, default=1024)
+    ap.add_argument("--ticks", type=int, default=100)
+    ap.add_argument("--out", type=Path, default=Path("nf_trace.json"))
+    ap.add_argument("--xprof", type=Path, default=None,
+                    help="also wrap the run in a JAX profiler capture "
+                         "written to this log dir (open with TensorBoard)")
+    args = ap.parse_args()
+
+    import contextlib
+
+    from noahgameframe_tpu.game.world import build_benchmark_world
+    from noahgameframe_tpu.utils.metrics import profiler_trace
+
+    world = build_benchmark_world(args.entities)
+    tracer = world.telemetry.tracer
+    tracer.enabled = True
+    k = world.kernel
+
+    k.tick()  # compile outside the capture
+    tracer.clear()
+
+    prof = (profiler_trace(str(args.xprof)) if args.xprof is not None
+            else contextlib.nullcontext())
+    with prof:
+        for _ in range(args.ticks):
+            with tracer.span("tick", tick=k.tick_count):
+                k.tick()
+    n = tracer.export(args.out)
+    print(f"wrote {n} spans over {args.ticks} ticks to {args.out}")
+    if args.xprof is not None:
+        print(f"device profile in {args.xprof} (tensorboard --logdir)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

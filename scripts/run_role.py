@@ -49,7 +49,8 @@ def main() -> int:
     ap.add_argument("--id", type=int, required=True, help="server id in Server.xml")
     ap.add_argument("--server-xml", required=True, type=Path)
     ap.add_argument("--http-port", type=int, default=None,
-                    help="master only: status HTTP port")
+                    help="HTTP port: the master serves /json + /metrics "
+                         "on it; every other role serves /metrics")
     ap.add_argument("--tick-sleep", type=float, default=0.001,
                     help="main-loop sleep (reference: 1 ms)")
     ap.add_argument("--crash-log-dir", type=Path, default=Path("crashlogs"),
@@ -100,6 +101,10 @@ def main() -> int:
     if args.role == "master" and args.http_port is not None:
         kwargs["http_port"] = args.http_port
     role = cls(config, **kwargs)
+    if args.role != "master" and args.http_port is not None:
+        h = role.serve_metrics(args.http_port)
+        print(f"{args.role} id={config.server_id} /metrics on "
+              f"{config.ip}:{h.port}", flush=True)
     print(f"{args.role} id={config.server_id} listening on "
           f"{config.ip}:{config.port}", flush=True)
     try:
