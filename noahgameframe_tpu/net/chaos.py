@@ -1,0 +1,265 @@
+"""Deterministic fault injection at the transport seam.
+
+`FaultyTransport` wraps any transport from :mod:`net.transport` (client
+or server side — it proxies both `send_msg` and `send(conn_id, ...)`)
+and injects message-level faults on the way out and on the way in:
+drops, duplicates, delays (measured in poll ticks), payload truncation/
+corruption, connection refusal, and directional partitions.  All
+decisions come from one per-link `random.Random` seeded from
+``(plan.seed, link name)``, so the same plan over the same message
+sequence yields a byte-identical fault sequence — chaos tests are
+reproducible, not merely "usually pass".
+
+Design notes:
+
+- Faults apply to *message bodies*, never to frame headers: the wrapper
+  sits above the framing layer, so a corrupted body exercises handler
+  fault isolation (`_Dispatch._safe`) while the stream stays parseable.
+  Frame-level garbage is a different failure class, covered directly by
+  the `FrameDecoder` fuzz in ``tests/test_wire_fuzz.py``.
+- The wrapper's clock is its poll count (one tick per ``poll()`` call),
+  not wall time: partition windows and delay durations are scheduleable
+  in tests without sleeping.
+- Partitions drop established-link messages only; EV_CONNECTED /
+  EV_DISCONNECTED pass through (a real partition stalls traffic, it
+  does not synthesize socket closes).  Use ``refuse`` to fault the
+  connect path itself.
+- Per-link counts, the fault log, AND the rng live *outside* the
+  wrapper (see :class:`ChaosDirector`): every re-dial builds a fresh
+  transport + wrapper, and both the failure budget and the random
+  sequence must survive that — a restarted rng would re-roll the same
+  outcome on every connect attempt (``refuse`` would be all-or-nothing
+  per link instead of a probability).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from .transport import EV_CONNECTED, EV_DISCONNECTED, EV_MSG, NetEvent
+
+
+@dataclasses.dataclass
+class LinkFaults:
+    """Per-link fault probabilities (each applied per message per
+    direction) + scheduled partitions."""
+
+    drop: float = 0.0       # message silently discarded
+    dup: float = 0.0        # message delivered twice
+    delay: float = 0.0      # message held for `delay_polls` ticks
+    delay_polls: int = 3
+    truncate: float = 0.0   # body cut at a random offset
+    corrupt: float = 0.0    # one body byte flipped
+    refuse: float = 0.0     # EV_CONNECTED turned into a disconnect
+    # refuse connects until the link's refuse count reaches this floor —
+    # a *deterministic* retry exercise (the budget lives in the shared
+    # counts, so it survives re-dials and then the link heals for good)
+    refuse_first: int = 0
+    # (start_tick, end_tick, direction) windows; direction is one of
+    # "in", "out", "both".  Ticks are poll counts on this link.
+    partitions: Tuple[Tuple[int, int, str], ...] = ()
+
+    def any(self) -> bool:
+        return bool(self.drop or self.dup or self.delay or self.truncate
+                    or self.corrupt or self.refuse or self.refuse_first
+                    or self.partitions)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A seeded schedule of per-link faults.
+
+    ``links`` maps a *pattern* to its faults; a pattern matches any link
+    whose name contains it (link names look like ``game6.world->7``), so
+    one entry can target a pool ("proxy5.games") or a single peer.
+    First matching pattern (insertion order) wins; unmatched links get
+    ``default``."""
+
+    seed: int = 0
+    links: Dict[str, LinkFaults] = dataclasses.field(default_factory=dict)
+    default: LinkFaults = dataclasses.field(default_factory=LinkFaults)
+
+    def for_link(self, link: str) -> LinkFaults:
+        for pattern, faults in self.links.items():
+            if pattern in link:
+                return faults
+        return self.default
+
+
+class FaultyTransport:
+    """Transport wrapper applying a `FaultPlan` to one link.
+
+    Everything not intercepted (connect/close/connected/port/…)
+    delegates to the wrapped transport, so the wrapper drops into
+    `NetClientModule`/`NetServerModule` unchanged.
+    """
+
+    def __init__(self, inner, link: str, plan: FaultPlan,
+                 counts: Optional[Dict[str, int]] = None,
+                 log: Optional[list] = None,
+                 rng: Optional[random.Random] = None) -> None:
+        self.inner = inner
+        self.link = str(link)
+        self.faults = plan.for_link(self.link)
+        # the rng may be shared across re-dials (ChaosDirector passes a
+        # per-link one): a fresh wrapper restarting the sequence would
+        # re-roll the SAME outcome on every connect attempt — refuse=0.25
+        # becomes either never or a permanent livelock
+        self.rng = rng if rng is not None else random.Random(
+            (int(plan.seed) * 1000003) ^ zlib.crc32(self.link.encode())
+        )
+        self.counts = counts if counts is not None else {}
+        self.log = log
+        self.tick = 0
+        self._delayed_out: List[Tuple[int, object]] = []  # (due, thunk)
+        self._delayed_in: List[Tuple[int, NetEvent]] = []
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    # ------------------------------------------------------- bookkeeping
+    def _count(self, kind: str, msg_id: int = 0) -> None:
+        self.counts[kind] = self.counts.get(kind, 0) + 1
+        if self.log is not None:
+            self.log.append((self.tick, self.link, kind, int(msg_id)))
+
+    def _partitioned(self, direction: str) -> bool:
+        for start, end, d in self.faults.partitions:
+            if start <= self.tick < end and d in (direction, "both"):
+                return True
+        return False
+
+    def _mangle(self, body: bytes, msg_id: int) -> bytes:
+        f, r = self.faults, self.rng
+        if body and f.truncate and r.random() < f.truncate:
+            self._count("truncate", msg_id)
+            body = body[: r.randrange(len(body))]
+        if body and f.corrupt and r.random() < f.corrupt:
+            self._count("corrupt", msg_id)
+            i = r.randrange(len(body))
+            body = body[:i] + bytes([body[i] ^ (1 + r.randrange(255))]) + body[i + 1:]
+        return body
+
+    # ------------------------------------------------------- send path
+    def send_msg(self, msg_id: int, body: bytes) -> bool:
+        return self._send_out(
+            lambda b: self.inner.send_msg(msg_id, b), msg_id, body
+        )
+
+    def send(self, conn_id: int, msg_id: int, body: bytes) -> bool:
+        return self._send_out(
+            lambda b: self.inner.send(conn_id, msg_id, b), msg_id, body
+        )
+
+    def _send_out(self, deliver, msg_id: int, body: bytes) -> bool:
+        f, r = self.faults, self.rng
+        if self._partitioned("out"):
+            self._count("partition_out", msg_id)
+            return True  # swallowed; the sender sees a healthy link
+        if f.drop and r.random() < f.drop:
+            self._count("drop_out", msg_id)
+            return True
+        body = self._mangle(body, msg_id)
+        copies = 1
+        if f.dup and r.random() < f.dup:
+            self._count("dup_out", msg_id)
+            copies = 2
+        if f.delay and r.random() < f.delay:
+            self._count("delay_out", msg_id)
+            due = self.tick + max(1, int(f.delay_polls))
+            for _ in range(copies):
+                self._delayed_out.append((due, lambda b=body: deliver(b)))
+            return True
+        ok = True
+        for _ in range(copies):
+            ok = deliver(body) and ok
+        return ok
+
+    # ------------------------------------------------------- poll path
+    def poll(self) -> List[NetEvent]:
+        self.tick += 1
+        # release due delayed traffic first: a delayed message must not
+        # overtake one delayed earlier (list order is arrival order)
+        still = []
+        for due, thunk in self._delayed_out:
+            if due <= self.tick:
+                thunk()
+            else:
+                still.append((due, thunk))
+        self._delayed_out = still
+        ready = [ev for due, ev in self._delayed_in if due <= self.tick]
+        self._delayed_in = [
+            (due, ev) for due, ev in self._delayed_in if due > self.tick
+        ]
+        out: List[NetEvent] = list(ready)
+        f, r = self.faults, self.rng
+        for ev in self.inner.poll():
+            if ev.kind == EV_CONNECTED and (
+                (f.refuse_first
+                 and self.counts.get("refuse", 0) < int(f.refuse_first))
+                or (f.refuse and r.random() < f.refuse)
+            ):
+                # connection refused: tear the link down instead of
+                # admitting it — exercises the RetryPolicy path
+                self._count("refuse")
+                self.inner.disconnect()
+                out.append(NetEvent(EV_DISCONNECTED, ev.conn_id))
+                continue
+            if ev.kind != EV_MSG:
+                out.append(ev)
+                continue
+            if self._partitioned("in"):
+                self._count("partition_in", ev.msg_id)
+                continue
+            if f.drop and r.random() < f.drop:
+                self._count("drop_in", ev.msg_id)
+                continue
+            body = self._mangle(ev.body, ev.msg_id)
+            ev = NetEvent(EV_MSG, ev.conn_id, ev.msg_id, body)
+            copies = 1
+            if f.dup and r.random() < f.dup:
+                self._count("dup_in", ev.msg_id)
+                copies = 2
+            if f.delay and r.random() < f.delay:
+                self._count("delay_in", ev.msg_id)
+                due = self.tick + max(1, int(f.delay_polls))
+                for _ in range(copies):
+                    self._delayed_in.append((due, ev))
+                continue
+            for _ in range(copies):
+                out.append(ev)
+        return out
+
+
+class ChaosDirector:
+    """One per cluster: wraps transports and owns the per-link fault
+    counts + logs so they survive transport rebuilds (every reconnect
+    dial creates a fresh client)."""
+
+    def __init__(self, plan: FaultPlan) -> None:
+        self.plan = plan
+        self.counts: Dict[str, Dict[str, int]] = {}
+        self.logs: Dict[str, list] = {}
+        self.rngs: Dict[str, random.Random] = {}
+
+    def wrap(self, transport, link: str) -> FaultyTransport:
+        link = str(link)
+        return FaultyTransport(
+            transport, link, self.plan,
+            counts=self.counts.setdefault(link, {}),
+            log=self.logs.setdefault(link, []),
+            rng=self.rngs.setdefault(link, random.Random(
+                (int(self.plan.seed) * 1000003) ^ zlib.crc32(link.encode())
+            )),
+        )
+
+    def total(self, kind: Optional[str] = None) -> int:
+        return sum(
+            v
+            for per_link in self.counts.values()
+            for k, v in per_link.items()
+            if kind is None or k == kind
+        )

@@ -208,3 +208,14 @@ class MsgID(enum.IntEnum):
 #: Reference cadence constants (NFINetClientModule.hpp:349,397)
 KEEPALIVE_SECONDS = 10.0
 RECONNECT_SECONDS = 10.0
+
+#: Backoff ceiling for the reconnect RetryPolicy (net/retry.py);
+#: RECONNECT_SECONDS stays the policy's *base* delay.
+RECONNECT_CAP_SECONDS = 30.0
+
+#: Heartbeat-lease thresholds (net/roles/master.py, world.py): an entry
+#: not refreshed for SUSPECT ages is flagged, past DOWN it is treated as
+#: dead (CRASH state, evicted from routed lists).  Tied to the 10 s
+#: keepalive: 1.5 missed beats suspect, 3 missed beats down.
+LEASE_SUSPECT_SECONDS = 1.5 * KEEPALIVE_SECONDS
+LEASE_DOWN_SECONDS = 3.0 * KEEPALIVE_SECONDS

@@ -22,6 +22,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..core.chash import ConsistentHash
 from .defines import KEEPALIVE_SECONDS, RECONNECT_SECONDS, ServerType
+from .retry import RetryPolicy
 from .transport import EV_CONNECTED, EV_DISCONNECTED, EV_MSG, NetEvent, create_client, create_server
 from .wire import Ident, Message, MsgBase
 
@@ -194,6 +195,7 @@ class ServerData:
     state: int = DISCONNECT
     last_attempt: float = 0.0
     client: object = None  # transport client
+    attempts: int = 0  # consecutive failed dials (resets on connect)
 
 
 class NetClientModule:
@@ -201,14 +203,24 @@ class NetClientModule:
 
     def __init__(self, backend: str = "auto",
                  reconnect_seconds: float = RECONNECT_SECONDS,
-                 keepalive_seconds: float = KEEPALIVE_SECONDS) -> None:
+                 keepalive_seconds: float = KEEPALIVE_SECONDS,
+                 retry: Optional[RetryPolicy] = None) -> None:
         self._backend = backend
         self.servers: Dict[int, ServerData] = {}
         self.ring: ConsistentHash[int] = ConsistentHash()
         self.counters = NetCounters()
         self.dispatch = _Dispatch(counters=self.counters)
+        # reconnect_seconds doubles as the CONNECTING timeout and, when
+        # no explicit policy is given, the RetryPolicy base delay
         self.reconnect_seconds = reconnect_seconds
+        self.retry = retry if retry is not None else RetryPolicy(base=reconnect_seconds)
         self.keepalive_seconds = keepalive_seconds
+        # re-dial attempts after a failure, per server id (telemetry:
+        # nf_reconnects_total samples this lazily)
+        self.retries_total: Dict[int, int] = {}
+        # chaos seam: wraps each freshly-created transport client
+        # (fn(client, server_data) -> client); see net/chaos.py
+        self.transport_wrapper: Optional[Callable] = None
         self._last_keepalive = 0.0
         self._keepalive_fns: List[Callable[[], None]] = []
         self._connected_fns: List[Callable[[int], None]] = []
@@ -298,19 +310,31 @@ class NetClientModule:
 
     def _pump_link(self, sd: ServerData, now: float) -> None:
         if sd.state in (DISCONNECT, RECONNECT):
-            if sd.state == RECONNECT and now - sd.last_attempt < self.reconnect_seconds:
-                return
+            if sd.state == RECONNECT:
+                # capped exponential backoff with deterministic jitter
+                # replaces the reference's fixed 10 s timer
+                wait = self.retry.delay(sd.attempts, key=sd.server_id)
+                if now - sd.last_attempt < wait:
+                    return
+                self.retries_total[sd.server_id] = (
+                    self.retries_total.get(sd.server_id, 0) + 1
+                )
             if sd.client is not None:
                 sd.client.close()
-            sd.client = create_client(sd.ip, sd.port, backend=self._backend)
+            client = create_client(sd.ip, sd.port, backend=self._backend)
+            if self.transport_wrapper is not None:
+                client = self.transport_wrapper(client, sd)
+            sd.client = client
             sd.client.connect()
             sd.state = CONNECTING
             sd.last_attempt = now
+            sd.attempts += 1
             return
         events = sd.client.poll()
         for ev in events:
             if ev.kind == EV_CONNECTED:
                 sd.state = NORMAL
+                sd.attempts = 0  # reset-on-success: next failure backs off from base
                 for fn in self._connected_fns:
                     fn(sd.server_id)
             elif ev.kind == EV_DISCONNECTED:

@@ -141,6 +141,7 @@ class ServerRole:
             )
         self.clients[key] = pool
         self.telemetry.add_net_source(key, pool.counters)
+        self.telemetry.add_pool_source(key, pool)
         return pool
 
     def serve_metrics(self, port: int = 0,

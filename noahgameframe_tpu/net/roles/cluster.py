@@ -12,10 +12,12 @@ via ``scripts/run_role.py`` with a shared Server.xml.
 from __future__ import annotations
 
 import time as _time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ...game.world import GameWorld
+from ..chaos import ChaosDirector, FaultPlan
 from ..defines import ServerType
+from ..retry import RetryPolicy
 from .base import RoleConfig
 from .game import GameRole
 from .login import LoginRole
@@ -34,20 +36,42 @@ class LocalCluster:
         game_world: Optional[GameWorld] = None,
         n_games: int = 1,
         keepalive_seconds: float = 0.2,
+        lease_suspect_seconds: Optional[float] = None,
+        lease_down_seconds: Optional[float] = None,
+        game_kwargs: Optional[dict] = None,
     ) -> None:
         host = "127.0.0.1"
+        self._backend = backend
+        self._host = host
+        self.keepalive_seconds = keepalive_seconds
+        # extra GameRole kwargs (checkpoint_dir, checkpoint_seconds, …)
+        # remembered so revive_role() rebuilds an identical role
+        self._game_kwargs = dict(game_kwargs or {})
+        # killed-role configs by config name, revivable later
+        self._killed: Dict[str, RoleConfig] = {}
+        self.chaos: Optional[ChaosDirector] = None
+        master_kw = {}
+        world_kw = {}
+        if lease_suspect_seconds is not None:
+            master_kw["lease_suspect_seconds"] = lease_suspect_seconds
+        if lease_down_seconds is not None:
+            master_kw["lease_down_seconds"] = lease_down_seconds
+            world_kw["lease_down_seconds"] = lease_down_seconds
         self.master = MasterRole(
             RoleConfig(1, int(ServerType.MASTER), "Master1", host, 0),
             backend=backend,
             http_port=http_port,
+            **master_kw,
         )
         master_t = [self.master.config]
         self.world = WorldRole(
             RoleConfig(7, int(ServerType.WORLD), "World1", host, 0,
                        targets=master_t),
             backend=backend,
+            **world_kw,
         )
         world_t = [self.world.config]
+        self._world_t = world_t
         self.login = LoginRole(
             RoleConfig(4, int(ServerType.LOGIN), "Login1", host, 0,
                        targets=master_t),
@@ -66,14 +90,25 @@ class LocalCluster:
                                f"Game{i + 1}", host, 0, targets=world_t),
                     backend=backend,
                     world=game_world if i == 0 else None,
+                    **self._game_kwargs,
                 )
             )
         self.game = self.games[0]
         self.roles = [self.master, self.world, self.login, self.proxy, *self.games]
         # speed up the registration/report cadence for in-process runs
         for role in self.roles:
-            for pool in role.clients.values():
-                pool.keepalive_seconds = keepalive_seconds
+            self._speed_role(role)
+
+    def _speed_role(self, role) -> None:
+        """Scale every outbound pool's cadence to the cluster keepalive:
+        reports at `keepalive_seconds`, re-dials backing off from it (the
+        library defaults are sized for real deployments — a test cluster
+        on a 10 s reconnect timer would make every fault take minutes)."""
+        ka = self.keepalive_seconds
+        for pool in role.clients.values():
+            pool.keepalive_seconds = ka
+            pool.retry = RetryPolicy(base=ka, cap=max(1.0, 5 * ka))
+            pool.reconnect_seconds = max(1.0, 5 * ka)  # CONNECTING timeout
 
     # ------------------------------------------------------------- pump
     def execute(self) -> None:
@@ -123,3 +158,88 @@ class LocalCluster:
     def shut(self) -> None:
         for role in self.roles:
             role.shut()
+
+    # ----------------------------------------------------------- chaos
+    @staticmethod
+    def _role_name(role) -> str:
+        return (type(role).__name__.replace("Role", "").lower()
+                + str(role.config.server_id))
+
+    def apply_chaos(self, plan: FaultPlan) -> ChaosDirector:
+        """Interpose a :class:`FaultyTransport` on every outbound link of
+        every role (link names like ``proxy5.games->6``; FaultPlan
+        patterns substring-match them).  Faults survive re-dials: the
+        director owns the per-link counters and each fresh transport the
+        pool creates is wrapped again."""
+        self.chaos = ChaosDirector(plan)
+        for role in self.roles:
+            self._chaos_role(role)
+        return self.chaos
+
+    def _chaos_role(self, role) -> None:
+        if self.chaos is None:
+            return
+        rname = self._role_name(role)
+        director = self.chaos
+
+        def make_wrapper(key: str):
+            def wrap(client, sd):
+                return director.wrap(
+                    client, f"{rname}.{key}->{sd.server_id}"
+                )
+            return wrap
+
+        for key, pool in role.clients.items():
+            pool.transport_wrapper = make_wrapper(key)
+            # wrap links that are already live (apply_chaos after start)
+            for sd in pool.servers.values():
+                if sd.client is not None:
+                    sd.client = director.wrap(
+                        sd.client, f"{rname}.{key}->{sd.server_id}"
+                    )
+        role.telemetry.add_chaos_source(director, prefix=f"{rname}.")
+
+    # ----------------------------------------------------- kill / revive
+    def kill_role(self, role) -> RoleConfig:
+        """Hard-kill one role: sockets dropped, removed from the pump.
+        Accepts the role object or its config name.  Returns the config
+        (revive_role uses the remembered name)."""
+        if isinstance(role, str):
+            role = next(r for r in self.roles if r.config.name == role)
+        role.shut()
+        self.roles.remove(role)
+        if role in self.games:
+            self.games.remove(role)
+        if self.game is role:
+            self.game = self.games[0] if self.games else None
+        self._killed[role.config.name] = role.config
+        return role.config
+
+    def revive_role(self, name: str, world: Optional[GameWorld] = None,
+                    resume: bool = True) -> GameRole:
+        """Bring a killed game role back on a fresh ephemeral port,
+        resuming from its checkpoint by default.  Re-registration with
+        World (and Master, via the relay) rides the normal on-connect
+        path; the proxy learns the new endpoint from World's next game
+        list push."""
+        cfg = self._killed.pop(name)
+        if cfg.server_type != int(ServerType.GAME):
+            raise NotImplementedError(
+                f"revive_role supports game roles only, not {name}"
+            )
+        kwargs = dict(self._game_kwargs)
+        kwargs["resume"] = resume
+        role = GameRole(
+            RoleConfig(cfg.server_id, cfg.server_type, cfg.name,
+                       self._host, 0, targets=self._world_t),
+            backend=self._backend,
+            world=world,
+            **kwargs,
+        )
+        self._speed_role(role)
+        self._chaos_role(role)
+        self.games.append(role)
+        if self.game is None:
+            self.game = role
+        self.roles.append(role)
+        return role

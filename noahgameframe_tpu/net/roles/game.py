@@ -156,6 +156,9 @@ class GameRole(ServerRole):
         cross_server_sync: bool = True,
         batch_sync_min: int = 256,
         interest_radius: Optional[float] = None,
+        checkpoint_dir=None,
+        checkpoint_seconds: float = 30.0,
+        resume: bool = False,
     ) -> None:
         # (class, prop) diffs with >= batch_sync_min changed rows go out
         # as ONE columnar ACK_BATCH_PROPERTY message per (cell, conn)
@@ -195,7 +198,30 @@ class GameRole(ServerRole):
         self._last_tick = 0.0
         self.autosave_seconds = autosave_seconds
         self._last_autosave = 0.0
+        # crash recovery: periodic atomic whole-world checkpoints
+        # (persist/checkpoint.py) + resume-on-boot; re-registration with
+        # world/master happens through the normal on-connect path
+        from pathlib import Path as _Path
+
+        self.checkpoint_dir = _Path(checkpoint_dir) if checkpoint_dir else None
+        self.checkpoint_seconds = checkpoint_seconds
+        self._last_checkpoint = 0.0
         super().__init__(config, backend=backend)
+        reg = self.telemetry.registry
+        self._ckpt_counter = reg.counter(
+            "nf_checkpoints_total", "atomic world checkpoints written"
+        )
+        self._recover_counter = reg.counter(
+            "nf_recoveries_total", "world restores from checkpoint (resume)"
+        )
+        if resume and self.checkpoint_dir is not None:
+            if (self.checkpoint_dir / "meta.json").exists():
+                # restores device banks + host identity; a torn pair
+                # raises (load_world's array_tick guard) rather than
+                # resuming a corrupt world
+                self.game_world.load(self.checkpoint_dir)
+                self._recover_counter.inc()
+            # no checkpoint yet -> cold start
         # world-tick latency, separate from the pump's frame histogram
         # (a pump frame with no tick due is ~free; mixing them would
         # drown the tick percentiles in poll noise)
@@ -1292,6 +1318,19 @@ class GameRole(ServerRole):
             for sess in self.sessions.values():
                 if sess.guid is not None and sess.guid in self.kernel.store.guid_map:
                     self.data_agent.save(sess.guid)
+        # periodic whole-world checkpoint (atomic rename; see
+        # persist/checkpoint.py) — the resume path in __init__ restores
+        # the latest one after a crash
+        if (self.checkpoint_dir is not None
+                and now - self._last_checkpoint >= self.checkpoint_seconds):
+            self._last_checkpoint = now
+            self.checkpoint_now()
+
+    def checkpoint_now(self):
+        """Write one atomic whole-world checkpoint; returns its path."""
+        self.game_world.save(self.checkpoint_dir)
+        self._ckpt_counter.inc()
+        return self.checkpoint_dir
 
     def _queue_change(self, cname: str, pname: str, rows: np.ndarray) -> None:
         """Property-event sink: accumulate changed rows per (class, prop);

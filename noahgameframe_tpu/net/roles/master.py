@@ -16,7 +16,13 @@ import html
 import time as _time
 from typing import Dict, Optional
 
-from ..defines import MsgID, ServerState, ServerType
+from ..defines import (
+    LEASE_DOWN_SECONDS,
+    LEASE_SUSPECT_SECONDS,
+    MsgID,
+    ServerState,
+    ServerType,
+)
 from ..http import HttpServer
 from ..module import EV_DISCONNECTED
 from ..transport import EV_CONNECTED
@@ -31,11 +37,18 @@ from ..wire import (
 from .base import RoleConfig, ServerRole, decode_reports, report_to_dict
 
 
+# heartbeat-lease states: every refresh/report renews the lease; a
+# server that stops reporting ages UP -> SUSPECT -> DOWN (the reference
+# lists dead entries forever — NFCMasterNet_ServerModule never expires)
+LEASE_UP, LEASE_SUSPECT, LEASE_DOWN = "UP", "SUSPECT", "DOWN"
+
+
 @dataclasses.dataclass
 class _Registered:
     report: ServerInfoReport
     conn_id: int = -1  # -1: known only via relayed report (no direct link)
     last_seen: float = 0.0
+    lease: str = LEASE_UP
 
 
 class MasterRole(ServerRole):
@@ -45,11 +58,24 @@ class MasterRole(ServerRole):
     server_type = int(ServerType.MASTER)
 
     def __init__(self, config: RoleConfig, backend: str = "auto",
-                 http_port: Optional[int] = None) -> None:
+                 http_port: Optional[int] = None,
+                 lease_suspect_seconds: float = LEASE_SUSPECT_SECONDS,
+                 lease_down_seconds: float = LEASE_DOWN_SECONDS) -> None:
         # per-type registries: type -> server_id -> _Registered
         self.registry: Dict[int, Dict[int, _Registered]] = {}
         self.http: Optional[HttpServer] = None
+        self.lease_suspect_seconds = lease_suspect_seconds
+        self.lease_down_seconds = lease_down_seconds
         super().__init__(config, backend=backend)
+        reg = self.telemetry.registry
+        self._lease_expirations = reg.counter(
+            "nf_lease_expirations_total",
+            "leases aged past the DOWN threshold", ("role",),
+        )
+        self._lease_recoveries = reg.counter(
+            "nf_lease_recoveries_total",
+            "DOWN servers seen reporting again", ("role",),
+        )
         if http_port is not None:
             self.http = HttpServer(config.ip, http_port)
             self.http.route("/json", lambda _p, _q: self.servers_status())
@@ -94,7 +120,44 @@ class MasterRole(ServerRole):
 
     def _upsert(self, r: ServerInfoReport, conn_id: int) -> None:
         by_id = self.registry.setdefault(int(r.server_type), {})
+        prev = by_id.get(r.server_id)
+        recovered = prev is not None and prev.lease == LEASE_DOWN
         by_id[r.server_id] = _Registered(r, conn_id, _time.monotonic())
+        if recovered:
+            # a DOWN server reporting again has recovered (restart or
+            # healed partition): count it and restore routing
+            self._lease_recoveries.inc(role=self._type_name(int(r.server_type)))
+            if int(r.server_type) == int(ServerType.WORLD):
+                self._push_world_list()
+
+    @staticmethod
+    def _type_name(stype: int) -> str:
+        try:
+            return ServerType(stype).name.lower()
+        except ValueError:
+            return str(stype)
+
+    def _sweep_leases(self, now: float) -> None:
+        """Age every lease; flips feed the counters, DOWN marks the
+        report CRASH and drops the server from routed lists (worlds
+        vanish from the login list; world does the same for games)."""
+        for stype, by_id in self.registry.items():
+            for reg in by_id.values():
+                age = now - reg.last_seen
+                if age >= self.lease_down_seconds:
+                    state = LEASE_DOWN
+                elif age >= self.lease_suspect_seconds:
+                    state = LEASE_SUSPECT
+                else:
+                    state = LEASE_UP
+                if state == reg.lease:
+                    continue
+                reg.lease = state
+                if state == LEASE_DOWN:
+                    reg.report.server_state = int(ServerState.CRASH)
+                    self._lease_expirations.inc(role=self._type_name(stype))
+                    if stype == int(ServerType.WORLD):
+                        self._push_world_list()
 
     def _on_socket(self, conn_id: int, kind: int) -> None:
         if kind != EV_DISCONNECTED:
@@ -110,8 +173,13 @@ class MasterRole(ServerRole):
     # ------------------------------------------------ world list to logins
     def _world_reports(self) -> ServerInfoReportList:
         worlds = self.registry.get(int(ServerType.WORLD), {})
+        # DOWN worlds are evicted from the routed list (SUSPECT still
+        # routes: one late heartbeat must not unseat a healthy server)
         return ServerInfoReportList(
-            server_list=[reg.report for reg in worlds.values()]
+            server_list=[
+                reg.report for reg in worlds.values()
+                if reg.lease != LEASE_DOWN
+            ]
         )
 
     def _send_world_list(self, conn_id: int) -> None:
@@ -151,16 +219,19 @@ class MasterRole(ServerRole):
 
     # ------------------------------------------------------ status JSON
     def servers_status(self) -> dict:
-        """Whole-cluster aggregate (`GetServersStatus` JSON)."""
+        """Whole-cluster aggregate (`GetServersStatus` JSON), one entry
+        per server with its lease state and heartbeat age."""
+        now = _time.monotonic()
         out: Dict[str, list] = {}
         for stype, by_id in sorted(self.registry.items()):
-            try:
-                key = ServerType(stype).name.lower()
-            except ValueError:
-                key = str(stype)
-            out[key] = [
-                report_to_dict(reg.report) for _, reg in sorted(by_id.items())
-            ]
+            key = self._type_name(stype)
+            entries = []
+            for _, reg in sorted(by_id.items()):
+                d = report_to_dict(reg.report)
+                d["lease"] = reg.lease
+                d["last_seen_age_s"] = round(max(0.0, now - reg.last_seen), 3)
+                entries.append(d)
+            out[key] = entries
         return {
             "master": report_to_dict(self.report()),
             "servers": out,
@@ -192,24 +263,30 @@ class MasterRole(ServerRole):
                     state = str(s["state"])
                 name = html.escape(str(s['name']))
                 endpoint = html.escape(f"{s['ip']}:{s['port']}")
+                lease = html.escape(str(s.get("lease", "?")))
+                age = s.get("last_seen_age_s", 0.0)
                 rows.append(
                     f"<tr><td>{html.escape(group)}</td><td>{s['server_id']}</td>"
                     f"<td>{name}</td><td>{endpoint}</td>"
                     f"<td>{s['cur_count']}/{s['max_online']}</td>"
-                    f"<td>{html.escape(str(state))}</td></tr>"
+                    f"<td>{html.escape(str(state))}</td>"
+                    f"<td>{lease} ({age:.1f}s)</td></tr>"
                 )
         return (
             "<html><head><title>cluster status</title></head><body>"
             "<h2>Cluster status</h2>"
             "<table border=1 cellpadding=4><tr><th>role</th><th>id</th>"
-            "<th>name</th><th>endpoint</th><th>load</th><th>state</th></tr>"
+            "<th>name</th><th>endpoint</th><th>load</th><th>state</th>"
+            "<th>lease (heartbeat age)</th></tr>"
             + "".join(rows)
             + "</table><p><a href='/json'>raw json</a></p></body></html>"
         )
 
     # ------------------------------------------------------------ pump
     def execute(self, now: Optional[float] = None) -> None:
+        now = _time.monotonic() if now is None else now
         super().execute(now)
+        self._sweep_leases(now)
         if self.http is not None:
             self.http.execute()
 

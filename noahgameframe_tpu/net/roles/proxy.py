@@ -18,7 +18,7 @@ import hmac
 import time as _time
 from typing import Dict, Optional, Tuple
 
-from ..defines import EventCode, MsgID, ServerType
+from ..defines import EventCode, MsgID, ServerState, ServerType
 from ..module import NORMAL, NetClientModule
 from ..transport import EV_DISCONNECTED
 from ..wire import (
@@ -64,6 +64,8 @@ class ProxyRole(ServerRole):
         # outbound pool to game servers (fed by World's game list)
         self.games = NetClientModule(backend=self.backend)
         self.clients["games"] = self.games
+        self.telemetry.add_net_source("games", self.games.counters)
+        self.telemetry.add_pool_source("games", self.games)
         # switch re-route before the catch-all: the target game tells us
         # its client moved; we re-point the binding, the client never
         # sees the control message (reference: gate handles
@@ -98,6 +100,10 @@ class ProxyRole(ServerRole):
         (a restarted game comes back on a new ephemeral port)."""
         seen = set()
         for r in decode_reports(body):
+            if int(r.server_state) == int(ServerState.CRASH):
+                # lease-evicted / crashed upstream: leave it out of
+                # `seen` so the prune below stops routing to it
+                continue
             sid = r.server_id
             ip = r.server_ip.decode("utf-8", "replace")
             seen.add(sid)

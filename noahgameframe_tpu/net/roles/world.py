@@ -18,7 +18,7 @@ import os
 import time as _time
 from typing import Dict, List, Optional
 
-from ..defines import MsgID, ServerType
+from ..defines import LEASE_DOWN_SECONDS, MsgID, ServerState, ServerType
 from ..transport import EV_DISCONNECTED
 from ..wire import (
     AckConnectWorldResult,
@@ -69,13 +69,21 @@ class _Downstream:
 class WorldRole(ServerRole):
     server_type = int(ServerType.WORLD)
 
-    def __init__(self, config: RoleConfig, backend: str = "auto") -> None:
+    def __init__(self, config: RoleConfig, backend: str = "auto",
+                 lease_down_seconds: float = LEASE_DOWN_SECONDS) -> None:
         self.games: Dict[int, _Downstream] = {}
         self.proxies: Dict[int, _Downstream] = {}
+        # a downstream that stops reporting for this long is treated as
+        # dead even if its socket looks alive (half-open link/partition)
+        self.lease_down_seconds = lease_down_seconds
         # world roster: online player ident -> owning game server id
         # (fed by ACK_ONLINE/OFFLINE_NOTIFY; the reference's OnOnlineProcess)
         self.roster: Dict[tuple, int] = {}
         super().__init__(config, backend=backend)
+        self._lease_expirations = self.telemetry.registry.counter(
+            "nf_lease_expirations_total",
+            "downstream leases aged past the DOWN threshold", ("role",),
+        )
         self.master = self.add_upstream(
             "master",
             [t for t in config.targets if t.server_type == int(ServerType.MASTER)],
@@ -169,9 +177,18 @@ class WorldRole(ServerRole):
         now = _time.monotonic()
         for r in decode_reports(body):
             book = self.games if r.server_type == int(ServerType.GAME) else self.proxies
-            if r.server_id in book:
-                book[r.server_id].report = r
-                book[r.server_id].last_seen = now
+            cur = book.get(r.server_id)
+            if cur is not None:
+                cur.report = r
+                cur.last_seen = now
+            elif conn_id >= 0:
+                # a live reporter we don't know: its registration was
+                # lost (dropped under chaos) or its lease false-expired —
+                # re-adopt; the keepalive doubles as registration
+                book[r.server_id] = _Downstream(r, conn_id, now)
+                self.server.conn_tags.setdefault(conn_id, {})["server_id"] = r.server_id
+                if r.server_type == int(ServerType.GAME):
+                    self._push_game_list()
             self._relay_report(r)
 
     def _relay_report(self, r: ServerInfoReport) -> None:
@@ -183,16 +200,38 @@ class WorldRole(ServerRole):
     def _on_socket(self, conn_id: int, kind: int) -> None:
         if kind != EV_DISCONNECTED:
             return
-        from ..defines import ServerState
-
         dead = [v for v in list(self.games.values()) + list(self.proxies.values())
                 if v.conn_id == conn_id]
         self.games = {k: v for k, v in self.games.items() if v.conn_id != conn_id}
         self.proxies = {k: v for k, v in self.proxies.items() if v.conn_id != conn_id}
+        if dead:
+            self._mark_dead(dead)
+
+    def _sweep_leases(self, now: float) -> None:
+        """Expire downstreams whose reports stopped arriving: a link can
+        stay ESTABLISHED while the peer is partitioned away or wedged.
+        Evicted entries re-adopt on their next report (upsert above)."""
+        dead = [v for v in list(self.games.values()) + list(self.proxies.values())
+                if now - v.last_seen >= self.lease_down_seconds]
         if not dead:
             return
-        # unplanned death: tell Master (CRASH state) and re-push the game
-        # list so proxies stop routing to the corpse
+        gone = {id(v) for v in dead}
+        self.games = {k: v for k, v in self.games.items() if id(v) not in gone}
+        self.proxies = {k: v for k, v in self.proxies.items() if id(v) not in gone}
+        for d in dead:
+            role = (
+                "game" if d.report.server_type == int(ServerType.GAME)
+                else "proxy"
+            )
+            self._lease_expirations.inc(role=role)
+            if d.conn_id >= 0:
+                self.server.close_conn(d.conn_id)
+        self._mark_dead(dead)
+
+    def _mark_dead(self, dead: List[_Downstream]) -> None:
+        """Shared death path (socket loss or lease expiry): tell Master
+        (CRASH state) and re-push the game list so proxies stop routing
+        to the corpse."""
         dead_ids = set()
         for d in dead:
             d.report.server_state = int(ServerState.CRASH)
@@ -210,6 +249,12 @@ class WorldRole(ServerRole):
                     d.conn_id, int(MsgID.ACK_OFFLINE_NOTIFY), body
                 )
         self._push_game_list()
+
+    # ------------------------------------------------------------ pump
+    def execute(self, now: Optional[float] = None) -> None:
+        now = _time.monotonic() if now is None else now
+        super().execute(now)
+        self._sweep_leases(now)
 
     # ---------------------------------------------- game list to proxies
     def _game_reports(self) -> ServerInfoReportList:

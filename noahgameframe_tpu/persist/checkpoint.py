@@ -15,6 +15,8 @@ container, so checkpoints are debuggable with numpy alone.
 from __future__ import annotations
 
 import json
+import os
+import shutil
 from pathlib import Path
 from typing import Dict, List
 
@@ -52,15 +54,23 @@ def _flatten_state(state: WorldState) -> Dict[str, np.ndarray]:
 
 
 def save_world(kernel: Kernel, path: Path, modules=()) -> None:
-    """Snapshot the whole world (device state + host identity) to disk.
+    """Snapshot the whole world (device state + host identity) to disk,
+    atomically: everything is written into a temp sibling directory and
+    renamed into place, so a crash mid-save leaves either the previous
+    checkpoint or the new one — never a torn arrays.npz/meta.json pair.
 
     `modules` — iterable of Modules whose `checkpoint_state()` host state
     (teams, guild name index, mailboxes, rank lists, buff defs…) must
     survive the resume; without them a restored player's TeamID would
     point at a Team entity the TeamModule no longer knows."""
     path = Path(path)
-    path.mkdir(parents=True, exist_ok=True)
-    np.savez_compressed(path / "arrays.npz", **_flatten_state(kernel.state))
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.parent / f".{path.name}.tmp{os.getpid()}"
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    tmp.mkdir(parents=True)
+    arrays = _flatten_state(kernel.state)
+    np.savez_compressed(tmp / "arrays.npz", **arrays)
     store = kernel.store
     mod_states = {}
     for m in modules:
@@ -71,6 +81,9 @@ def save_world(kernel: Kernel, path: Path, modules=()) -> None:
         "modules": mod_states,
         "class_order": store.class_order,
         "tick_count": kernel.tick_count,
+        # the device tick duplicated host-side: load_world cross-checks
+        # it against arrays.npz so a mixed pair is rejected, not resumed
+        "array_tick": int(arrays["tick"]),
         "strings": store.strings.snapshot(),
         "guids": {
             f"{g.head}-{g.data}": int(h) for g, h in store.guid_map.items()
@@ -86,7 +99,19 @@ def save_world(kernel: Kernel, path: Path, modules=()) -> None:
             for cname, host in store._hosts.items()
         },
     }
-    (path / "meta.json").write_text(json.dumps(meta))
+    (tmp / "meta.json").write_text(json.dumps(meta))
+    # swap into place: os.replace can't overwrite a non-empty dir, so an
+    # existing checkpoint is renamed aside first (the only non-atomic
+    # window leaves a complete .old copy next to the complete new one)
+    if path.exists():
+        old = path.parent / f".{path.name}.old{os.getpid()}"
+        if old.exists():
+            shutil.rmtree(old)
+        os.replace(path, old)
+        os.replace(tmp, path)
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.replace(tmp, path)
 
 
 def load_world(kernel: Kernel, path: Path, modules=()) -> None:
@@ -98,6 +123,12 @@ def load_world(kernel: Kernel, path: Path, modules=()) -> None:
     path = Path(path)
     arrays = np.load(path / "arrays.npz")
     meta = json.loads((path / "meta.json").read_text())
+    recorded = meta.get("array_tick")
+    if recorded is not None and int(recorded) != int(arrays["tick"]):
+        raise ValueError(
+            f"torn checkpoint: meta.json array_tick={int(recorded)} "
+            f"disagrees with arrays.npz tick={int(arrays['tick'])}"
+        )
     store = kernel.store
     if meta["class_order"] != store.class_order:
         raise ValueError(

@@ -52,6 +52,8 @@ class TelemetryModule(Module):
         )
         self.census = MemoryCensus()
         self._net_sources: Dict[str, object] = {}
+        self._pool_sources: Dict[str, object] = {}  # link -> NetClientModule
+        self._chaos_sources: list = []  # (link prefix, ChaosDirector)
         self._kernel_attached = False
         self._role_attached = False
         self.registry.register_callback(
@@ -66,6 +68,14 @@ class TelemetryModule(Module):
             "nf_net_bytes_total", lambda: self._net_samples(1),
             kind="counter", help="payload bytes per link/direction/opcode",
         )
+        self.registry.register_callback(
+            "nf_reconnects_total", self._pool_samples, kind="counter",
+            help="re-dial attempts after a link failure, per pool/server",
+        )
+        self.registry.register_callback(
+            "nf_chaos_faults_total", self._chaos_samples, kind="counter",
+            help="injected faults per link and kind (net/chaos.py)",
+        )
 
     # ------------------------------------------------------------ sources
     def _frame_quantiles(self) -> Iterable[Tuple[dict, float]]:
@@ -76,6 +86,32 @@ class TelemetryModule(Module):
     def add_net_source(self, link: str, counters) -> None:
         """Register a NetCounters (net/module.py) under a link label."""
         self._net_sources[str(link)] = counters
+
+    def add_pool_source(self, link: str, pool) -> None:
+        """Register a NetClientModule whose ``retries_total`` feeds
+        ``nf_reconnects_total`` under a link label."""
+        self._pool_sources[str(link)] = pool
+
+    def _pool_samples(self) -> Iterable[Tuple[dict, float]]:
+        for link, pool in sorted(self._pool_sources.items()):
+            for sid in sorted(pool.retries_total):
+                yield (
+                    {"link": link, "server_id": str(sid)},
+                    pool.retries_total[sid],
+                )
+
+    def add_chaos_source(self, director, prefix: str = "") -> None:
+        """Register a ChaosDirector (net/chaos.py); only links starting
+        with `prefix` are exposed (one role sees its own links)."""
+        self._chaos_sources.append((str(prefix), director))
+
+    def _chaos_samples(self) -> Iterable[Tuple[dict, float]]:
+        for prefix, director in self._chaos_sources:
+            for link in sorted(director.counts):
+                if prefix and not link.startswith(prefix):
+                    continue
+                for kind, v in sorted(director.counts[link].items()):
+                    yield ({"link": link, "kind": kind}, v)
 
     def _net_samples(self, which: int) -> Iterable[Tuple[dict, float]]:
         for link, c in sorted(self._net_sources.items()):

@@ -61,6 +61,14 @@ def main() -> int:
              "(control-plane roles and tests; the sitecustomize "
              "overrides JAX_PLATFORMS env at startup)",
     )
+    ap.add_argument("--checkpoint-dir", type=Path, default=None,
+                    help="game role: directory for periodic atomic "
+                         "whole-world checkpoints")
+    ap.add_argument("--checkpoint-seconds", type=float, default=30.0,
+                    help="game role: seconds between checkpoints")
+    ap.add_argument("--resume", action="store_true",
+                    help="game role: restore the latest checkpoint from "
+                         "--checkpoint-dir before serving")
     args = ap.parse_args()
     if args.platform == "cpu":
         from noahgameframe_tpu.utils.platform import force_cpu
@@ -100,6 +108,10 @@ def main() -> int:
     kwargs = {}
     if args.role == "master" and args.http_port is not None:
         kwargs["http_port"] = args.http_port
+    if args.role == "game" and args.checkpoint_dir is not None:
+        kwargs["checkpoint_dir"] = args.checkpoint_dir
+        kwargs["checkpoint_seconds"] = args.checkpoint_seconds
+        kwargs["resume"] = args.resume
     role = cls(config, **kwargs)
     if args.role != "master" and args.http_port is not None:
         h = role.serve_metrics(args.http_port)

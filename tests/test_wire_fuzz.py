@@ -66,3 +66,75 @@ def test_roundtrip_fuzz(cls):
         raw = m.encode()
         back = cls.decode(raw)
         assert m == back, (cls.__name__, raw.hex())
+
+
+# ---------------------------------------------------------------- framing
+# the chaos layer (net/chaos.py) duplicates, truncates, and corrupts
+# message *bodies*; this section pins the framing layer's contract under
+# the stream-level equivalents: dup/re-chunked/short streams never crash
+# the decoder, and garbage headers fail ONLY with ProtocolError.
+
+from noahgameframe_tpu.net.framing import (  # noqa: E402
+    FrameDecoder,
+    HEAD_LENGTH,
+    ProtocolError,
+    pack_frame,
+)
+
+
+def _frames(rng, n=20):
+    return [
+        (rng.randrange(1, 1000),
+         bytes(rng.randrange(256) for _ in range(rng.randrange(0, 64))))
+        for _ in range(n)
+    ]
+
+
+def test_frame_duplicates_decode_twice():
+    rng = random.Random(1)
+    frames = _frames(rng)
+    dec = FrameDecoder()
+    stream = b"".join(pack_frame(m, b) + pack_frame(m, b) for m, b in frames)
+    got = dec.feed(stream)
+    want = [f for pair in zip(frames, frames) for f in pair]
+    assert got == want
+
+
+def test_frame_random_chunking_identical():
+    rng = random.Random(2)
+    frames = _frames(rng)
+    stream = b"".join(pack_frame(m, b) for m, b in frames)
+    for trial in range(5):
+        r = random.Random(100 + trial)
+        dec = FrameDecoder()
+        got, i = [], 0
+        while i < len(stream):
+            j = min(len(stream), i + r.randrange(1, 17))
+            got.extend(dec.feed(stream[i:j]))
+            i = j
+        assert got == frames, f"chunking trial {trial}"
+
+
+def test_frame_truncated_tail_pends_without_crash():
+    rng = random.Random(3)
+    frames = _frames(rng, n=5)
+    stream = b"".join(pack_frame(m, b) for m, b in frames)
+    # cut mid-final-frame: everything complete decodes, the tail pends
+    cut = len(stream) - len(frames[-1][1]) // 2 - 1
+    dec = FrameDecoder()
+    assert dec.feed(stream[:cut]) == frames[:-1]
+    # the rest of the bytes complete the pending frame
+    assert dec.feed(stream[cut:]) == frames[-1:]
+
+
+def test_frame_corrupt_headers_raise_protocol_error_only():
+    rng = random.Random(4)
+    for _ in range(200):
+        n = rng.randrange(HEAD_LENGTH, 64)
+        garbage = bytes(rng.randrange(256) for _ in range(n))
+        dec = FrameDecoder()
+        try:
+            dec.feed(garbage)
+        except ProtocolError:
+            pass  # the one sanctioned failure mode
+        # anything else (struct.error, IndexError, …) fails the test
