@@ -88,11 +88,13 @@ def _evict_harvester() -> None:
             capture_output=True, text=True, timeout=10,
         )
         victims = []
+        harvester_pgids = set()
         my_pgid = os.getpgid(0)
         for line in (r.stdout or "").split():
             try:
                 pid = int(line)
                 pgid = os.getpgid(pid)
+                harvester_pgids.add(pgid)
                 if pgid == my_pgid:
                     # harvester launched from OUR process group (no job
                     # control): killpg would take bench.py down with it —
@@ -106,8 +108,10 @@ def _evict_harvester() -> None:
             except (ValueError, ProcessLookupError, PermissionError):
                 pass
         # the harvester's in-flight CAPTURE child is what actually holds
-        # the TPU claim — kill it directly too (killpg already covers it
-        # unless the harvester shared OUR pgid and was pid-killed above)
+        # the TPU claim — kill it directly too, but ONLY if it belongs to
+        # a process group a first-pass harvester was found in: a bare
+        # command-line match would SIGTERM any operator-run capture or
+        # profile session machine-wide
         r2 = subprocess.run(
             ["pgrep", "-f", r"python -u .*(bench\.py|profile_\w+\.py|"
                             r"capture_trace\.py) .*--platform tpu|"
@@ -118,7 +122,7 @@ def _evict_harvester() -> None:
         for line in (r2.stdout or "").split():
             try:
                 pid = int(line)
-                if pid != os.getpid():
+                if pid != os.getpid() and os.getpgid(pid) in harvester_pgids:
                     os.kill(pid, signal.SIGTERM)
                     victims.append(pid)
             except (ValueError, ProcessLookupError, PermissionError):
@@ -449,6 +453,7 @@ def run_bench(args) -> dict:
     import jax
 
     from noahgameframe_tpu.game import build_benchmark_world
+    from noahgameframe_tpu.ops.verlet import skin_from_env
     from noahgameframe_tpu.utils.platform import init_compile_cache
 
     init_compile_cache()
@@ -523,6 +528,22 @@ def run_bench(args) -> dict:
         k.run_device(lat_k, reconcile=False)
         jax.block_until_ready(k.state.classes["NPC"].i32)
         dev_hist.observe((time.perf_counter() - t1) / lat_k)
+    # Verlet cache effectiveness (NF_VERLET_SKIN > 0): lifetime counters
+    # off the carried caches in state.aux — rebuilds/tick is the
+    # amortization the skin bought (1.0 == rebuilt every tick).  Read
+    # BEFORE the reconciling tick: if that tick observes bucket overflow
+    # the combat module invalidates, which (correctly) drops the caches.
+    verlet = {}
+    for key, c in (getattr(k.state, "aux", None) or {}).items():
+        if not key.startswith("verlet/"):
+            continue
+        reb = int(jax.device_get(c.rebuilds))
+        reu = int(jax.device_get(c.reuses))
+        verlet[key[len("verlet/"):]] = {
+            "rebuilds": reb,
+            "reuses": reu,
+            "rebuilds_per_tick": round(reb / max(1, reb + reu), 4),
+        }
     k.tick()  # reconcile host free-lists once, outside timing; also
     # fetches the on-device counter bank for the detail block below
     dp50, dp95, dp99 = _hist_pcts(dev_hist)
@@ -560,6 +581,14 @@ def run_bench(args) -> dict:
             "att_overflow_max": att_drop,
             # on-device counter bank from the reconciling tick above
             "tick_counters": dict(k.last_counters),
+            **(
+                {
+                    "verlet": verlet,
+                    "verlet_skin": skin_from_env(),
+                }
+                if verlet
+                else {}
+            ),
         },
     }
 
@@ -801,11 +830,17 @@ def main() -> None:
         args.ticks = 90
 
     # apply measured A/B winners (harvest queue -> scripts/decide_tuning.py
-    # -> bench_runs/tuning.json) on the TPU path only; explicit env vars
-    # still override via setdefault.  CPU fallbacks keep defaults — the
-    # tuning was measured on chip and does not transfer.
+    # -> bench_runs/tuning.json) on any on-chip path: --platform tpu, and
+    # pinned --platform auto runs whose probe SUCCEEDED (probe_note is
+    # only None here when the accelerator answered — unpinned successes
+    # returned via the ladder above, whose tpu subprocesses re-enter this
+    # branch themselves).  Explicit env vars still override via
+    # setdefault.  CPU fallbacks keep defaults — the tuning was measured
+    # on chip and does not transfer.
     tuning_applied = {}
-    if args.platform == "tpu":
+    if args.platform == "tpu" or (
+        args.platform == "auto" and probe_note is None
+    ):
         tpath = os.path.join(os.path.dirname(__file__), "bench_runs",
                              "tuning.json")
         try:

@@ -139,6 +139,11 @@ class WorldState:
     classes: Dict[str, ClassState]
     tick: jnp.ndarray  # int32 scalar
     rng: jnp.ndarray  # PRNG key
+    # module-owned carried tick state (e.g. the Verlet grid caches of
+    # ops/verlet.py), keyed by registering module; pytree-of-arrays only.
+    # Kernel.register_aux primes entries lazily so worlds that use no
+    # aux carry an empty dict (zero structural change).
+    aux: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 @jax.jit

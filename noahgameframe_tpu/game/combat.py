@@ -35,9 +35,15 @@ from ..ops.stencil import (
     pull,
     stencil_fold,
 )
+from ..ops.verlet import full_table, init_cache, refresh, skin_from_env, sub_table
 from .defines import GameEvent
 
 ATTACK_TIMER = "Attack"
+
+# "no attacker" sentinel for the f32 best-row accumulator: 2^24, exactly
+# representable and strictly above every representable row id (< 2^24).
+# Deliberately finite — see combat_fold_closure.
+NO_ROW = 16777216.0
 
 
 def combat_fold_closure(v, radius):
@@ -71,19 +77,41 @@ def combat_fold_closure(v, radius):
             & (cgroup == vgroup[..., None])  # ...and group
         )
         inc = inc + jnp.sum(jnp.where(ok, ca, 0.0), axis=-1).astype(idt)
-        # strongest attacker; ties resolve to the first candidate in
-        # (stencil, slot) order — slots hold ascending rows, so the
-        # within-shift tie-break is min-row
+        # strongest attacker; ties resolve to the GLOBAL minimum row id
+        # among equal-max in-range attackers.  Min-row (not first-in-
+        # stencil-order) makes the answer independent of which cell each
+        # attacker is binned in, so Verlet-cached anchor binnings
+        # (ops/verlet.py) produce bit-identical LastAttacker to a fresh
+        # rebuild.  bestr accumulates as f32 (NO_ROW = none: a finite
+        # sentinel, not +inf — an inf loop carry sends the XLA CPU
+        # algebraic simplifier into a non-terminating rewrite cycle) and
+        # the XLA / Pallas wrappers convert to int32 at the end.
         sa = jnp.where(ok, ca, -1.0)
         m = jnp.max(sa, axis=-1)
-        first = jnp.min(jnp.where(sa >= m[..., None], cr, jnp.inf), axis=-1)
-        better = m > besta
-        besta = jnp.where(better, m, besta)
-        bestr = jnp.where(better, first.astype(idt), bestr)
+        first = jnp.min(jnp.where(sa >= m[..., None], cr, NO_ROW), axis=-1)
+        # a shift with zero ok attackers has m == -1 and `first` reads the
+        # min over raw row columns — poison; neutralize before comparing
+        first = jnp.where(m >= 0.0, first, NO_ROW)
+        # merge (m, first) into (besta, bestr) as a lexicographic
+        # (max attack, min row) reduction.  Phrased so `bestr` is
+        # consumed exactly ONCE per shift: a second use (e.g. an extra
+        # tie-select `where(tie, minimum(bestr, first), bestr)`) makes
+        # the XLA CPU compiler blow up super-linearly on the 9-shift
+        # select chain (minutes -> never returns at width 48)
+        top = jnp.maximum(besta, m)
+        bestr = jnp.minimum(
+            jnp.where(m >= top, first, NO_ROW),
+            jnp.where(besta >= top, bestr, NO_ROW),
+        )
+        besta = top
         return inc, besta, bestr
 
     zeros = jnp.zeros(v.shape[:3], idt)
-    init = (zeros, jnp.zeros(v.shape[:3], f32) - 1.0, zeros - 1)
+    init = (
+        zeros,
+        jnp.zeros(v.shape[:3], f32) - 1.0,
+        jnp.full(v.shape[:3], NO_ROW, f32),
+    )
     return fold, init
 
 
@@ -104,6 +132,8 @@ def combat_fold_xla(vic_table, att_table, radius):
     no-friendly-fire mask rules self out of every pair."""
     fold, init = combat_fold_closure(vic_table.grid_view(), radius)
     inc, _besta, bestr = stencil_fold(att_table, fold, init)
+    # NO_ROW (no attacker) -> -1; row ids are exact in f32 (< 2^24)
+    bestr = jnp.where(bestr >= NO_ROW, -1.0, bestr).astype(jnp.int32)
     return inc, bestr
 
 
@@ -124,12 +154,22 @@ class CombatModule(Module):
         order: int = 30,
         emit_events: bool = True,
         use_pallas: Optional[bool] = None,
+        verlet_skin: Optional[float] = None,
     ):
         super().__init__()
         self.class_name = class_name
         self.extent = float(extent)
         self.radius = float(radius)
+        # Verlet skin (ops/verlet.py): None = NF_VERLET_SKIN env knob,
+        # <= 0 = off (rebuild every tick, exactly the legacy path).  A
+        # positive skin inflates the grid so the 3x3 stencil still covers
+        # the true radius from positions up to skin/2 stale.
+        self.verlet_skin = float(
+            verlet_skin if verlet_skin is not None else skin_from_env()
+        )
         self.cell_size = float(cell_size if cell_size is not None else max(radius, 1.0))
+        if self.verlet_skin > 0.0:
+            self.cell_size = max(self.cell_size, self.radius + self.verlet_skin)
         self.width = max(1, int(self.extent / self.cell_size))
         # None = size buckets from capacity/cell density at trace time so
         # overflow (entities silently missing combat) stays ~zero
@@ -171,6 +211,15 @@ class CombatModule(Module):
     def init(self) -> None:
         # timer slots must exist before the world is built
         self.kernel.schedule.register_timer(self.class_name, ATTACK_TIMER)
+        if self.verlet_skin > 0.0:
+            # the Verlet cache rides WorldState.aux as carried tick state;
+            # a zero cache forces a rebuild on the first tick, and
+            # kernel.invalidate() (bucket boost, duty change) drops it so
+            # slot assignments baked against stale geometry cannot leak
+            self.kernel.register_aux(
+                f"verlet/{self.class_name}",
+                lambda: init_cache(self.kernel.store.capacity(self.class_name)),
+            )
 
     def after_init(self) -> None:
         if self.emit_events:
@@ -340,11 +389,37 @@ class CombatModule(Module):
             [pos[:, 0], pos[:, 1], eff_atk, camp_f, scene_f, group_f, rows_f],
             axis=-1,
         )
-        # one argsort feeds both tables (attackers are a subset of alive)
-        vic_table, att_table = build_cell_table_pair(
-            pos, cs.alive, vic_feats, attacking, att_feats,
-            self.cell_size, self.width, bucket, att_bucket,
-        )
+        if self.verlet_skin > 0.0:
+            # displacement-gated build (ops/verlet.py): the argsort only
+            # runs when some entity drifted >= skin/2 from its binning
+            # anchor (or the alive set changed); otherwise both payload
+            # scatters replay against the cached slot assignment.  The
+            # fold below masks by TRUE radius on current positions, so
+            # results stay bit-identical to rebuilding every tick.
+            aux_key = f"verlet/{cname}"
+            cache, rebuilt = refresh(
+                state.aux[aux_key], pos, cs.alive,
+                self.cell_size, self.width, bucket, self.verlet_skin,
+            )
+            n_cells = self.width * self.width
+            vic_table = full_table(
+                cache, vic_feats, cs.alive, n_cells,
+                self.cell_size, self.width, bucket,
+            )
+            att_table = sub_table(
+                cache, attacking, att_feats, n_cells,
+                self.cell_size, self.width, att_bucket,
+            )
+            ctx.count("grid_rebuilds", rebuilt)
+            ctx.count("grid_reuses", 1 - rebuilt)
+            ctx.count("grid_cache_age", cache.age)
+            state = state.replace(aux={**state.aux, aux_key: cache})
+        else:
+            # one argsort feeds both tables (attackers subset of alive)
+            vic_table, att_table = build_cell_table_pair(
+                pos, cs.alive, vic_feats, attacking, att_feats,
+                self.cell_size, self.width, bucket, att_bucket,
+            )
         pallas_on = self.use_pallas
         if pallas_on is None:
             import os

@@ -62,6 +62,9 @@ class WorldConfig:
     combat: bool = True
     movement: bool = True
     regen: bool = True
+    # Verlet skin for the combat grid (ops/verlet.py); None defers to the
+    # NF_VERLET_SKIN env knob, <= 0 disables (rebuild every tick)
+    verlet_skin: Optional[float] = None
     middleware: bool = True  # items/hero/task/buff stack
     # private is included so owner-only state (EXP, Gold, bag counters)
     # reaches its own client (GetBroadCastObject: Private -> self)
@@ -141,6 +144,7 @@ class GameWorld:
                 bucket=cfg.aoi_bucket,
                 respawn_s=cfg.respawn_s,
                 attack_period_s=cfg.attack_period_s,
+                verlet_skin=cfg.verlet_skin,
             )
             modules.append(self.combat)
         if cfg.regen:

@@ -180,6 +180,12 @@ class Kernel(Module):
         self._pending_destroy: List[Guid] = []
         self._event_meta: List[Tuple[int, str, Tuple[str, ...]]] = []
         self.tick_count = 0
+        # module-registered carried tick state (WorldState.aux): name ->
+        # zero-arg init fn.  Entries are primed lazily right before
+        # dispatch (_ensure_aux) so registration order vs build order
+        # doesn't matter, and invalidate() drops them (aux layouts bake
+        # trace-time geometry, e.g. Verlet slot assignments)
+        self._aux_init: Dict[str, Callable[[], Any]] = {}
         # counter-bank decode order, captured at trace time like
         # _event_meta (static per compilation)
         self._counter_names: Tuple[str, ...] = ()
@@ -383,9 +389,44 @@ class Kernel(Module):
     def invalidate(self) -> None:
         """Force retrace of the compiled tick.  Call after changing
         anything phases close over (config tables, phase lists) — traced
-        constants do NOT update on their own."""
+        constants do NOT update on their own.  Registered aux entries are
+        dropped too: their layouts bake the same trace-time geometry
+        (bucket sizes, grid widths), so a stale Verlet slot assignment
+        must not survive a retrace — _ensure_aux re-primes zero caches
+        and the first new tick rebuilds them."""
         self._jit_step = None
         self._jit_run = None
+        if self._aux_init and self.state is not None and self.state.aux:
+            kept = {
+                k: v for k, v in self.state.aux.items()
+                if k not in self._aux_init
+            }
+            if len(kept) != len(self.state.aux):
+                self.state = self.state.replace(aux=kept)
+
+    # -- carried aux state ---------------------------------------------------
+
+    def register_aux(self, name: str, init_fn: Callable[[], Any]) -> None:
+        """Register module-owned carried tick state (WorldState.aux).
+
+        `init_fn` returns a pytree of arrays; it is called lazily before
+        the next dispatch (so store capacities exist by then) and again
+        after every invalidate().  Phases read `state.aux[name]` and
+        write back via `state.replace(aux={**state.aux, name: new})`."""
+        self._aux_init[name] = init_fn
+
+    def _ensure_aux(self) -> None:
+        """Prime any registered-but-missing aux entries before dispatch —
+        keeps the carried pytree structure stable across every tick()/
+        run_device() call of one compilation."""
+        if not self._aux_init:
+            return
+        missing = [k for k in self._aux_init if k not in self.state.aux]
+        if missing:
+            aux = dict(self.state.aux)
+            for k in missing:
+                aux[k] = self._aux_init[k]()
+            self.state = self.state.replace(aux=aux)
 
     def _span(self, name: str):
         """Host-side tracer span if a tracer is attached, else free."""
@@ -396,6 +437,7 @@ class Kernel(Module):
     def tick(self) -> TickOutputs:
         """Advance the world one frame and fan out host-visible effects."""
         self.compile()
+        self._ensure_aux()
         with self._span("kernel.dispatch"):
             self.state, raw = self._jit_step(self.state)
         self.tick_count += 1
@@ -444,6 +486,7 @@ class Kernel(Module):
         lag the device until the next reconciling call; benchmark latency
         sampling is the intended user."""
         self.compile()
+        self._ensure_aux()
         key = int(n)
         if self._jit_run is None:
             # trip count rides in as a TRACED scalar so ONE compile

@@ -170,6 +170,16 @@ class GameRole(ServerRole):
         # avatar — group-granular broadcast is full-world fan-out when a
         # group is busy (round-3: 24.5 MB/frame at 100k / 500 sessions)
         self.interest_radius = interest_radius
+        # Verlet skin for the interest grids (NF_VERLET_SKIN knob,
+        # ops/verlet.py): > 0 inflates the interest cell size to
+        # radius + skin and amortizes the per-flush argsort across
+        # flushes via a displacement-gated cache carried in
+        # WorldState.aux ("verlet/interest/<class>")
+        from ...ops.verlet import skin_from_env
+
+        self._interest_skin = (
+            float(skin_from_env()) if interest_radius is not None else 0.0
+        )
         self._interest_jit: Dict[Tuple[str, int], object] = {}
         # classes with a create/destroy since the last interest flush
         # (visible sets can change without any Position diff)
@@ -1620,11 +1630,20 @@ class GameRole(ServerRole):
         changed[rows] = True
         cs = k.state.classes[cname]
         fn = self._interest_query(cname, len(obs_rows))
-        vrows, vok = fn(
-            cs.vec, cs.i32, jnp.asarray(changed),
-            k.state.classes["Player"].vec, k.state.classes["Player"].i32,
-            jnp.asarray(obs_rows), jnp.asarray(obs_valid),
-        )
+        if self._interest_skin > 0.0:
+            ckey, cache = self._interest_cache_for(cname)
+            vrows, vok, cache = fn(
+                cs.vec, cs.i32, jnp.asarray(changed), cs.alive,
+                k.state.classes["Player"].vec, k.state.classes["Player"].i32,
+                jnp.asarray(obs_rows), jnp.asarray(obs_valid), cache,
+            )
+            self._interest_cache_store(ckey, cache)
+        else:
+            vrows, vok = fn(
+                cs.vec, cs.i32, jnp.asarray(changed),
+                k.state.classes["Player"].vec, k.state.classes["Player"].i32,
+                jnp.asarray(obs_rows), jnp.asarray(obs_valid),
+            )
         vrows, vok = np.asarray(vrows), np.asarray(vok)
         for i, sess in enumerate(obs):
             g = sess.guid
@@ -1791,7 +1810,11 @@ class GameRole(ServerRole):
         import jax
         import jax.numpy as jnp
 
-        from ...ops.interest import quantize, visible_candidates
+        from ...ops.interest import (
+            quantize,
+            visible_candidates,
+            visible_candidates_cached,
+        )
         from ...ops.stencil import auto_bucket
 
         k = self.kernel
@@ -1803,23 +1826,45 @@ class GameRole(ServerRole):
         p_sc, p_gr = pspec.slots["SceneID"].col, pspec.slots["GroupID"].col
         extent = float(self.game_world.config.extent)
         radius = float(self.interest_radius)
-        width = max(1, int(np.ceil(extent / radius)))
+        skin = float(self._interest_skin)
+        # skin > 0 inflates the cell so the 3x3 read still covers the true
+        # radius from anchors up to skin/2 stale (ops/verlet.py)
+        cell = radius + skin if skin > 0.0 else radius
+        width = max(1, int(np.ceil(extent / cell)))
         cap = k.store.capacity(cname)
         bucket = auto_bucket(cap, width)
 
-        def step(evec, ei32, alive, pvec, pi32, obs_rows, obs_valid):
-            pos3 = evec[:, pos_col]
-            q, in_extent = quantize(pos3, alive, extent)
-            res = visible_candidates(
-                pos3, in_extent,
-                ei32[:, sc_col].astype(jnp.float32),
-                ei32[:, gr_col].astype(jnp.float32),
-                pvec[obs_rows, p_pos][:, :2],
-                pi32[obs_rows, p_sc].astype(jnp.float32),
-                pi32[obs_rows, p_gr].astype(jnp.float32),
-                radius=radius, cell_size=radius, width=width, bucket=bucket,
-            )
-            return q, res.rows, res.ok & obs_valid[:, None]
+        if skin > 0.0:
+            def step(evec, ei32, alive, pvec, pi32, obs_rows, obs_valid,
+                     cache):
+                pos3 = evec[:, pos_col]
+                q, in_extent = quantize(pos3, alive, extent)
+                res, cache, _rebuilt = visible_candidates_cached(
+                    cache, pos3, in_extent, alive,
+                    ei32[:, sc_col].astype(jnp.float32),
+                    ei32[:, gr_col].astype(jnp.float32),
+                    pvec[obs_rows, p_pos][:, :2],
+                    pi32[obs_rows, p_sc].astype(jnp.float32),
+                    pi32[obs_rows, p_gr].astype(jnp.float32),
+                    radius=radius, cell_size=cell, width=width,
+                    bucket=bucket, skin=skin,
+                )
+                return q, res.rows, res.ok & obs_valid[:, None], cache
+        else:
+            def step(evec, ei32, alive, pvec, pi32, obs_rows, obs_valid):
+                pos3 = evec[:, pos_col]
+                q, in_extent = quantize(pos3, alive, extent)
+                res = visible_candidates(
+                    pos3, in_extent,
+                    ei32[:, sc_col].astype(jnp.float32),
+                    ei32[:, gr_col].astype(jnp.float32),
+                    pvec[obs_rows, p_pos][:, :2],
+                    pi32[obs_rows, p_sc].astype(jnp.float32),
+                    pi32[obs_rows, p_gr].astype(jnp.float32),
+                    radius=radius, cell_size=cell, width=width,
+                    bucket=bucket,
+                )
+                return q, res.rows, res.ok & obs_valid[:, None]
 
         fn = jax.jit(step)
         self._interest_jit[key] = fn
@@ -1837,7 +1882,7 @@ class GameRole(ServerRole):
         import jax
         import jax.numpy as jnp
 
-        from ...ops.interest import visible_candidates
+        from ...ops.interest import visible_candidates, visible_candidates_cached
         from ...ops.stencil import auto_bucket
 
         k = self.kernel
@@ -1849,24 +1894,61 @@ class GameRole(ServerRole):
         p_sc, p_gr = pspec.slots["SceneID"].col, pspec.slots["GroupID"].col
         extent = float(self.game_world.config.extent)
         radius = float(self.interest_radius)
-        width = max(1, int(np.ceil(extent / radius)))
+        skin = float(self._interest_skin)
+        cell = radius + skin if skin > 0.0 else radius
+        width = max(1, int(np.ceil(extent / cell)))
         bucket = auto_bucket(k.store.capacity(cname), width)
 
-        def query(evec, ei32, changed, pvec, pi32, obs_rows, obs_valid):
-            res = visible_candidates(
-                evec[:, pos_col], changed,
-                ei32[:, sc_col].astype(jnp.float32),
-                ei32[:, gr_col].astype(jnp.float32),
-                pvec[obs_rows, p_pos][:, :2],
-                pi32[obs_rows, p_sc].astype(jnp.float32),
-                pi32[obs_rows, p_gr].astype(jnp.float32),
-                radius=radius, cell_size=radius, width=width, bucket=bucket,
-            )
-            return res.rows, res.ok & obs_valid[:, None]
+        if skin > 0.0:
+            def query(evec, ei32, changed, alive, pvec, pi32, obs_rows,
+                      obs_valid, cache):
+                res, cache, _rebuilt = visible_candidates_cached(
+                    cache, evec[:, pos_col], changed, alive,
+                    ei32[:, sc_col].astype(jnp.float32),
+                    ei32[:, gr_col].astype(jnp.float32),
+                    pvec[obs_rows, p_pos][:, :2],
+                    pi32[obs_rows, p_sc].astype(jnp.float32),
+                    pi32[obs_rows, p_gr].astype(jnp.float32),
+                    radius=radius, cell_size=cell, width=width,
+                    bucket=bucket, skin=skin,
+                )
+                return res.rows, res.ok & obs_valid[:, None], cache
+        else:
+            def query(evec, ei32, changed, pvec, pi32, obs_rows, obs_valid):
+                res = visible_candidates(
+                    evec[:, pos_col], changed,
+                    ei32[:, sc_col].astype(jnp.float32),
+                    ei32[:, gr_col].astype(jnp.float32),
+                    pvec[obs_rows, p_pos][:, :2],
+                    pi32[obs_rows, p_sc].astype(jnp.float32),
+                    pi32[obs_rows, p_gr].astype(jnp.float32),
+                    radius=radius, cell_size=cell, width=width,
+                    bucket=bucket,
+                )
+                return res.rows, res.ok & obs_valid[:, None]
 
         fn = jax.jit(query)
         self._interest_jit[key] = fn
         return fn
+
+    def _interest_cache_for(self, cname: str):
+        """The class's interest Verlet cache, carried in WorldState.aux
+        (key "verlet/interest/<class>") so telemetry, invalidate() and
+        sharded placement treat it like any other grid cache.  Registers
+        the aux init lazily on first use."""
+        from ...ops.verlet import init_cache
+
+        k = self.kernel
+        key = f"verlet/interest/{cname}"
+        if key not in k._aux_init:
+            cap = k.store.capacity(cname)
+            k.register_aux(key, lambda c=cap: init_cache(c))
+        k._ensure_aux()
+        return key, k.state.aux[key]
+
+    def _interest_cache_store(self, key: str, cache) -> None:
+        k = self.kernel
+        k.state = k.state.replace(aux={**k.state.aux, key: cache})
 
     def _interest_ok(self, cname: str) -> bool:
         """The interest lane needs spatial columns; classes without them
@@ -1930,11 +2012,20 @@ class GameRole(ServerRole):
         cs = k.state.classes[cname]
         pcs = k.state.classes["Player"]
         fn = self._interest_step(cname, len(obs_rows))
-        q, rows, ok = fn(
-            cs.vec, cs.i32, cs.alive,
-            pcs.vec, pcs.i32,
-            jnp.asarray(obs_rows), jnp.asarray(obs_valid),
-        )
+        if self._interest_skin > 0.0:
+            ckey, cache = self._interest_cache_for(cname)
+            q, rows, ok, cache = fn(
+                cs.vec, cs.i32, cs.alive,
+                pcs.vec, pcs.i32,
+                jnp.asarray(obs_rows), jnp.asarray(obs_valid), cache,
+            )
+            self._interest_cache_store(ckey, cache)
+        else:
+            q, rows, ok = fn(
+                cs.vec, cs.i32, cs.alive,
+                pcs.vec, pcs.i32,
+                jnp.asarray(obs_rows), jnp.asarray(obs_valid),
+            )
         q_np = np.asarray(q).astype(np.uint16)
         rows_np, ok_np = np.asarray(rows), np.asarray(ok)
         host = k.store._hosts[cname]
@@ -2021,11 +2112,20 @@ class GameRole(ServerRole):
         changed[rows] = True
         cs = k.state.classes[cname]
         fn = self._interest_query(cname, len(obs_rows))
-        vrows, vok = fn(
-            cs.vec, cs.i32, jnp.asarray(changed),
-            k.state.classes["Player"].vec, k.state.classes["Player"].i32,
-            jnp.asarray(obs_rows), jnp.asarray(obs_valid),
-        )
+        if self._interest_skin > 0.0:
+            ckey, cache = self._interest_cache_for(cname)
+            vrows, vok, cache = fn(
+                cs.vec, cs.i32, jnp.asarray(changed), cs.alive,
+                k.state.classes["Player"].vec, k.state.classes["Player"].i32,
+                jnp.asarray(obs_rows), jnp.asarray(obs_valid), cache,
+            )
+            self._interest_cache_store(ckey, cache)
+        else:
+            vrows, vok = fn(
+                cs.vec, cs.i32, jnp.asarray(changed),
+                k.state.classes["Player"].vec, k.state.classes["Player"].i32,
+                jnp.asarray(obs_rows), jnp.asarray(obs_valid),
+            )
         vrows, vok = np.asarray(vrows), np.asarray(vok)
         # one value gather for the changed set; per-session subsets map
         # through pos_of (changed row -> position in `rows`)

@@ -31,7 +31,8 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from .stencil import STENCIL, build_cell_table
+from .stencil import STENCIL, CellTable, build_cell_table
+from .verlet import VerletCache, refresh, sub_table
 
 QMAX = 65535  # u16 quantization range
 
@@ -89,8 +90,18 @@ def visible_candidates(
     Entities beyond a cell's `bucket` slots are dropped for the frame
     (they re-qualify next time they move; size via ops.stencil.auto_bucket
     to keep that ~zero)."""
+    feats = _interest_feats(pos, scene, group)
+    table = build_cell_table(pos, moved, feats, cell_size, width, bucket)
+    return _scan_observers(
+        table, obs_pos, obs_scene, obs_group, radius, cell_size
+    )
+
+
+def _interest_feats(pos, scene, group) -> jnp.ndarray:
+    """The candidate feature layout both builders share: row id, x, y,
+    scene, group (occupancy appended by the table builder)."""
     n = pos.shape[0]
-    feats = jnp.concatenate(
+    return jnp.concatenate(
         [
             jnp.arange(n, dtype=jnp.float32)[:, None],  # row id
             pos[:, :2].astype(jnp.float32),
@@ -99,7 +110,21 @@ def visible_candidates(
         ],
         axis=1,
     )
-    table = build_cell_table(pos, moved, feats, cell_size, width, bucket)
+
+
+def _scan_observers(
+    table: CellTable,
+    obs_pos: jnp.ndarray,
+    obs_scene: jnp.ndarray,
+    obs_group: jnp.ndarray,
+    radius: float,
+    cell_size: float,
+) -> InterestResult:
+    """The per-observer 3x3 read shared by the fresh and Verlet-cached
+    builders: observers index by their CURRENT cell, candidates mask by
+    TRUE radius on the current positions carried in the payload — which
+    is what keeps cached (anchor-binned) tables bit-identical, provided
+    cell_size >= radius + skin/2 covers the staleness."""
     grid = table.grid_view()  # [H, W, K, F+1]
     h, w, k, f = grid.shape
     inv = 1.0 / cell_size
@@ -124,3 +149,49 @@ def visible_candidates(
         rows=jnp.concatenate(cand_list, axis=1),
         ok=jnp.concatenate(ok_list, axis=1),
     )
+
+
+def visible_candidates_cached(
+    cache: VerletCache,
+    pos: jnp.ndarray,
+    moved: jnp.ndarray,  # [C] bool — this frame's candidate subset
+    alive: jnp.ndarray,  # [C] bool — the cache anchors over ALL alive rows
+    scene: jnp.ndarray,
+    group: jnp.ndarray,
+    obs_pos: jnp.ndarray,
+    obs_scene: jnp.ndarray,
+    obs_group: jnp.ndarray,
+    radius: float,
+    cell_size: float,
+    width: int,
+    bucket: int,
+    skin: float,
+) -> Tuple[InterestResult, VerletCache, jnp.ndarray]:
+    """`visible_candidates` with a Verlet-cached binning (ops/verlet.py):
+    the cache anchors the FULL alive population, and each frame's `moved`
+    subset rides a sub-table through the cached sorted order (a streaming
+    cumsum instead of an argsort — the moved set changes every frame, so
+    its table always refreshes; only the sort is amortized).
+
+    cell_size must be >= radius + skin (caller inflates its geometry);
+    the distance mask uses the true radius on current positions, so
+    results are bit-identical to the fresh builder on the same inflated
+    grid (modulo bucket-overflow drops — size generously).
+
+    Returns (result, new_cache, rebuilt i32) — thread the cache back in
+    next frame."""
+    # anchor over the STABLE alive set — anchoring on `moved` would flip
+    # the active mask (and force a rebuild) every frame.  moved & alive
+    # is then a subset of the anchor by construction, which is all
+    # sub_table needs.
+    cache, rebuilt = refresh(
+        cache, pos, alive, cell_size, width, bucket, skin
+    )
+    feats = _interest_feats(pos, scene, group)
+    table = sub_table(
+        cache, moved & alive, feats, width * width, cell_size, width, bucket
+    )
+    result = _scan_observers(
+        table, obs_pos, obs_scene, obs_group, radius, cell_size
+    )
+    return result, cache, rebuilt

@@ -214,21 +214,21 @@ def _sorted_segments(pos, active, cell_size: float, width: int,
     return n_cells, order, skey, seg_start, rank
 
 
-def _finish_table(
-    features, active, n_cells: int, order, skey, rank,
+def table_from_slots(
+    features, active, slot_of, n_cells: int,
     cell_size: float, width: int, bucket: int, height: int = -1,
 ) -> CellTable:
-    """Shared build suffix: slots from ranks, ONE deterministic scatter
-    (unique slot indices), dump-slot zeroing, drop count."""
+    """Materialize a CellTable from a PRECOMPUTED slot assignment: ONE
+    deterministic payload scatter (unique slot indices for placed rows),
+    dump-slot zeroing, drop count.  This is the sort-free half of the
+    build — the Verlet cache (ops/verlet.py) replays it every reuse tick
+    against the cached `slot_of` while skipping the argsort entirely.
+    Rows not `active` are forced to the dump slot regardless of their
+    cached assignment (a cache is only reused while the active set is
+    unchanged, but a zero-initialized cache must stay harmless)."""
     n = features.shape[0]
     dump = n_cells * bucket
-    placed = (rank < bucket) & (skey < n_cells)
-    flat_sorted = jnp.where(placed, skey * bucket + rank, dump)
-    # un-sort the slot assignment, then scatter features from ROW order —
-    # one scatter instead of a sorted-gather + scatter (each N-sized
-    # irregular op costs ~1 ms per 131k rows on a v5e; this is the hot
-    # per-tick build)
-    slot_of = jnp.full((n,), dump, jnp.int32).at[order].set(flat_sorted)
+    slot_of = jnp.where(active, slot_of, dump)
     occ = jnp.ones((n, 1), features.dtype)
     feats = jnp.concatenate([features, occ], axis=-1)
     payload = (
@@ -240,6 +240,24 @@ def _finish_table(
     payload = payload.at[dump].set(0.0)
     dropped = jnp.sum(active & (slot_of == dump), dtype=jnp.int32)
     return CellTable(payload, slot_of, dropped, width, cell_size, bucket, height)
+
+
+def _finish_table(
+    features, active, n_cells: int, order, skey, rank,
+    cell_size: float, width: int, bucket: int, height: int = -1,
+) -> CellTable:
+    """Shared build suffix: slots from ranks, then the payload scatter.
+    Un-sorting the slot assignment costs one scatter instead of a
+    sorted-gather + scatter (each N-sized irregular op costs ~1 ms per
+    131k rows on a v5e; this is the hot per-tick build)."""
+    n = features.shape[0]
+    dump = n_cells * bucket
+    placed = (rank < bucket) & (skey < n_cells)
+    flat_sorted = jnp.where(placed, skey * bucket + rank, dump)
+    slot_of = jnp.full((n,), dump, jnp.int32).at[order].set(flat_sorted)
+    return table_from_slots(
+        features, active, slot_of, n_cells, cell_size, width, bucket, height
+    )
 
 
 def build_cell_table(

@@ -37,6 +37,10 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+# "no attacker" sentinel (2^24) — must match game.combat.NO_ROW; finite
+# on purpose, an inf loop carry hangs the XLA CPU algebraic simplifier
+_NO_ROW = 16777216.0
+
 V_X, V_Y, V_CAMP, V_SCENE, V_GROUP = range(5)
 N_VFEATS = 5
 A_X, A_Y, A_ATK, A_CAMP, A_SCENE, A_GROUP, A_ROW = range(7)
@@ -54,7 +58,7 @@ def _kernel(vic_ref, top_ref, mid_ref, bot_ref, out_ref, *, w: int, r2: float):
 
     inc = jnp.zeros((kv, w), jnp.int32)
     besta = jnp.full((kv, w), -1.0, jnp.float32)
-    bestr = jnp.full((kv, w), -1.0, jnp.float32)
+    bestr = jnp.full((kv, w), _NO_ROW, jnp.float32)
 
     # stencil order (dy, dx) ascending — identical to ops.stencil.STENCIL
     for ref in (top_ref, mid_ref, bot_ref):
@@ -85,12 +89,19 @@ def _kernel(vic_ref, top_ref, mid_ref, bot_ref, out_ref, *, w: int, r2: float):
             first = jnp.min(
                 jnp.where(sa >= m[:, None, :],
                           jnp.broadcast_to(cr[None, :, :], (kv, ka, w)),
-                          jnp.inf),
+                          _NO_ROW),
                 axis=1,
             )
-            better = m > besta
-            besta = jnp.where(better, m, besta)
-            bestr = jnp.where(better, first, bestr)
+            # global min-row tie-break, identical to combat_fold_closure:
+            # neutralize empty shifts (m == -1), then lexicographic
+            # (max attack, min row) merge with `bestr` consumed once
+            first = jnp.where(m >= 0.0, first, _NO_ROW)
+            top = jnp.maximum(besta, m)
+            bestr = jnp.minimum(
+                jnp.where(m >= top, first, _NO_ROW),
+                jnp.where(besta >= top, bestr, _NO_ROW),
+            )
+            besta = top
 
     # bitcast keeps the exact int32 damage total through the f32 plane
     # (a value cast would round above 2^24)
@@ -141,7 +152,9 @@ def combat_fold_pallas(vic_table, att_table, radius: float, interpret: bool = Fa
     inc = jax.lax.bitcast_convert_type(
         out[:, 0].transpose(0, 2, 1), jnp.int32
     )  # [H, W(+pad), Kv]
-    bestr = out[:, 2].transpose(0, 2, 1).astype(jnp.int32)
+    bestr_f = out[:, 2].transpose(0, 2, 1)
+    # _NO_ROW (no attacker) -> -1; row ids are exact in f32 (< 2^24)
+    bestr = jnp.where(bestr_f >= _NO_ROW, -1.0, bestr_f).astype(jnp.int32)
     if w_pad:
         inc = inc[:, :width]
         bestr = bestr[:, :width]

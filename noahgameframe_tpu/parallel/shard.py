@@ -43,7 +43,10 @@ def world_shardings(state: WorldState, mesh: Mesh, axis: str = SHARD_AXIS):
         return rep
 
     classes = jax.tree.map(pick, state.classes)
-    return state.replace(classes=classes, tick=rep, rng=rep)
+    # aux carries module tick state (Verlet caches): per-entity leading
+    # axes shard like class banks, counters/anchors-of-scalars replicate
+    aux = jax.tree.map(pick, state.aux)
+    return state.replace(classes=classes, tick=rep, rng=rep, aux=aux)
 
 
 class ShardedKernel:
@@ -93,6 +96,10 @@ class ShardedKernel:
     # -- placement -----------------------------------------------------------
 
     def place(self) -> None:
+        # prime registered aux first: the sharding pytree must match the
+        # state pytree structurally, and priming later would leave new
+        # leaves off-mesh
+        self.kernel._ensure_aux()
         shardings = world_shardings(self.kernel.state, self.mesh)
         self.kernel.state = jax.device_put(self.kernel.state, shardings)
 
@@ -117,6 +124,7 @@ class ShardedKernel:
         from ..kernel.kernel import DeviceEvent, TickOutputs
 
         k = self.kernel
+        k._ensure_aux()
         step = self._compile()
         k.state, raw = step(k.state)
         k.tick_count += 1
@@ -167,6 +175,7 @@ class ShardedKernel:
         device-resident (no readbacks), and compile cost is one step's —
         what bench.py's ladder uses so compile doesn't dominate."""
         key = int(n)
+        self.kernel._ensure_aux()
         if not fused:
             step = self._compile_headless()
             for _ in range(key):

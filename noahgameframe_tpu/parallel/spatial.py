@@ -53,7 +53,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..game.combat import combat_fold_closure
 from ..ops.stencil import build_cell_table_pair, pull
+from ..ops.verlet import VerletCache, full_table, refresh, sub_table
 from .mesh import SHARD_AXIS, make_mesh
+
+# jax.shard_map landed as a top-level API (with check_vma) after 0.4.x;
+# older releases spell it jax.experimental.shard_map with check_rep.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _SM_KW = {"check_vma": False}
+else:  # pragma: no cover - exercised on jax<0.6 only
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SM_KW = {"check_rep": False}
 
 
 class SpatialGeom(NamedTuple):
@@ -73,6 +84,12 @@ class SpatialGeom(NamedTuple):
     regen_per_tick: int = 0   # hp regained per tick while alive
     hp_max: int = 0           # regen/respawn ceiling (0 = no ceiling)
     respawn_ticks: int = 0    # dead rows revive at hp_max after this many
+    # Verlet skin (ops/verlet.py): > 0 gates the per-slab sort+build on
+    # accumulated displacement.  Requires cell_size >= radius + skin.
+    # Any tick that migrates a row (or strands one mid-hop) changes the
+    # in-slab mask and forces a rebuild, so the win concentrates in ticks
+    # where no entity crosses a slab boundary.
+    skin: float = 0.0
 
     @property
     def slab_h(self) -> int:
@@ -90,6 +107,16 @@ class SpatialState(NamedTuple):
     gid: jnp.ndarray     # [cap] i32 — stable global id, rides migration
     died: jnp.ndarray    # [cap] i32 — tick of death, -1 while alive
     active: jnp.ndarray  # [cap] bool
+    # Verlet cache leaves (geom.skin > 0; carried zeros otherwise).
+    # Flattened VerletCache so the whole state stays one NamedTuple of
+    # row-sharded banks (cstat: [n_shards, 3] = rebuilds/reuses/age,
+    # one [1, 3] row per shard).
+    vc_pos: jnp.ndarray      # [cap, 2] f32 — anchor positions
+    vc_active: jnp.ndarray   # [cap] bool  — anchor in-slab mask
+    vc_order: jnp.ndarray    # [cap] i32
+    vc_skey: jnp.ndarray     # [cap] i32
+    vc_slot: jnp.ndarray     # [cap] i32
+    cstat: jnp.ndarray       # [n_shards, 3] i32
 
 
 def _walk(pos, gid, tick, geom: SpatialGeom):
@@ -150,7 +177,8 @@ def _life_phases(geom: SpatialGeom, hp, died, incoming, tick):
 
 
 def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, died,
-                  active, tick):
+                  active, vc_pos, vc_active, vc_order, vc_skey, vc_slot,
+                  cstat, tick):
     """One tick on one shard (runs under shard_map; arrays are the
     shard-local banks)."""
     n = geom.n_shards
@@ -178,8 +206,20 @@ def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, died,
         # hops one slab toward its owner per tick until it arrives —
         # otherwise it would be excluded from combat forever
         m = active & ((owner > me) if d == 1 else (owner < me))
+        # destination capacity vote: each shard advertises its free-slot
+        # count BEFORE clearing its own outbound rows (so the advertised
+        # number only understates reality), and the sender clamps its
+        # send to it — a row that would find no slot stays home and
+        # retries instead of leaving the source bank and being destroyed
+        # in flight.  Receiving the successor's count means permuting
+        # values BACKWARD (each shard sends its count to its predecessor).
+        free_cnt = jnp.sum(~active, dtype=jnp.int32)
+        remote_free = jax.lax.ppermute(
+            free_cnt, axis, bwd if d == 1 else fwd
+        )
+        cap_d = jnp.minimum(jnp.int32(geom.mig_budget), remote_free)
         csum = jnp.cumsum(m.astype(jnp.int32))
-        sel = m & (csum <= geom.mig_budget)
+        sel = m & (csum <= cap_d)
         migrated = migrated + jnp.sum(sel, dtype=jnp.int32)
         mig_overflow = mig_overflow + jnp.sum(m, dtype=jnp.int32) - jnp.sum(
             sel, dtype=jnp.int32
@@ -202,7 +242,10 @@ def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, died,
             .at[slots]
             .set(jnp.arange(pos.shape[0], dtype=jnp.int32))[: geom.mig_budget]
         )
-        dest_j = jnp.where(rvalid, dest, pos.shape[0])  # OOB => dropped
+        dest_j = jnp.where(rvalid, dest, pos.shape[0])
+        # should-never-fire assertion counter: the sender clamped to our
+        # advertised free count, so every arriving row has a slot; any
+        # nonzero here is a protocol bug, not expected overflow
         mig_dropped = mig_dropped + jnp.sum(
             rvalid & (dest_j >= pos.shape[0]), dtype=jnp.int32
         )
@@ -238,11 +281,38 @@ def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, died,
          gid.astype(f32)],
         -1,
     )
-    vic_t, att_t = build_cell_table_pair(
-        pos, in_slab, vic_feats, attacking, att_feats,
-        geom.cell_size, w, geom.bucket, geom.att_bucket,
-        cell=cell_local, height=hs,
-    )
+    if geom.skin > 0.0:
+        # displacement-gated build (ops/verlet.py): the anchor mask is the
+        # in-slab set, so any migration/straggler flip forces a rebuild —
+        # and the vote is pmax'd over the mesh so every shard's carried
+        # cache takes the same branch.  cell_local is derived from the
+        # same positions passed to refresh, as its contract requires.
+        cache = VerletCache(
+            anchor_pos=vc_pos, anchor_active=vc_active, order=vc_order,
+            skey=vc_skey, slot_of=vc_slot,
+            rebuilds=cstat[0, 0], reuses=cstat[0, 1], age=cstat[0, 2],
+        )
+        cache, _rebuilt = refresh(
+            cache, pos, in_slab, geom.cell_size, w, geom.bucket, geom.skin,
+            cell=cell_local, n_cells=hs * w, height=hs, axis_name=axis,
+        )
+        vic_t = full_table(
+            cache, vic_feats, in_slab, hs * w, geom.cell_size, w,
+            geom.bucket, height=hs,
+        )
+        att_t = sub_table(
+            cache, attacking, att_feats, hs * w, geom.cell_size, w,
+            geom.att_bucket, height=hs,
+        )
+        vc_pos, vc_active = cache.anchor_pos, cache.anchor_active
+        vc_order, vc_skey, vc_slot = cache.order, cache.skey, cache.slot_of
+        cstat = jnp.stack([cache.rebuilds, cache.reuses, cache.age])[None, :]
+    else:
+        vic_t, att_t = build_cell_table_pair(
+            pos, in_slab, vic_feats, attacking, att_feats,
+            geom.cell_size, w, geom.bucket, geom.att_bucket,
+            cell=cell_local, height=hs,
+        )
 
     # -- halo exchange: one dense attacker plane per edge ----------------
     ag = att_t.grid_view()  # [hs, w, K_att, F+1]
@@ -276,7 +346,8 @@ def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, died,
         [migrated, mig_overflow, mig_dropped, misplaced,
          vic_t.dropped, att_t.dropped]
     )[None, :]  # [1, 6] per shard -> [n_shards, 6] outside
-    return pos, hp, atk, camp, gid, died, active, stats
+    return (pos, hp, atk, camp, gid, died, active,
+            vc_pos, vc_active, vc_order, vc_skey, vc_slot, cstat, stats)
 
 
 class SpatialWorld:
@@ -294,6 +365,11 @@ class SpatialWorld:
                  bank_size: Optional[int] = None):
         if geom.width % geom.n_shards:
             raise ValueError("width must divide into n_shards slabs")
+        if geom.skin > 0.0 and geom.cell_size < geom.radius + geom.skin:
+            raise ValueError(
+                f"Verlet skin {geom.skin} needs cell_size >= radius + skin "
+                f"({geom.radius + geom.skin}), got {geom.cell_size}"
+            )
         self.geom = geom
         self.mesh = mesh if mesh is not None else make_mesh(geom.n_shards)
         self.axis = SHARD_AXIS
@@ -308,7 +384,12 @@ class SpatialWorld:
     # -- placement --------------------------------------------------------
     def place(self, pos: np.ndarray, hp: np.ndarray, atk: np.ndarray,
               camp: np.ndarray) -> None:
-        """Distribute entities into per-shard banks by their slab."""
+        """Distribute entities into per-shard banks by their slab.
+
+        Vectorized: one stable argsort by owning shard, per-shard base
+        offsets, and a single fancy-index write per bank — the previous
+        per-entity Python loop was O(n) interpreter work at placement
+        (minutes at 1M rows)."""
         g = self.geom
         n = pos.shape[0]
         cy = np.clip((pos[:, 1] / g.cell_size).astype(np.int32), 0,
@@ -317,6 +398,9 @@ class SpatialWorld:
         counts = np.bincount(owner, minlength=g.n_shards)
         bank = self.bank_size or int(1 << int(np.ceil(np.log2(
             max(counts.max() * 2, 64)))))
+        over = np.flatnonzero(counts > bank)
+        if over.size:
+            raise ValueError(f"bank {int(over[0])} overflow at placement")
         cap = bank * g.n_shards
         st = SpatialState(
             pos=np.zeros((cap, 2), np.float32),
@@ -326,19 +410,24 @@ class SpatialWorld:
             gid=np.full((cap,), -1, np.int32),
             died=np.full((cap,), -1, np.int32),
             active=np.zeros((cap,), bool),
+            vc_pos=np.zeros((cap, 2), np.float32),
+            vc_active=np.zeros((cap,), bool),
+            vc_order=np.zeros((cap,), np.int32),
+            vc_skey=np.zeros((cap,), np.int32),
+            vc_slot=np.zeros((cap,), np.int32),
+            cstat=np.zeros((g.n_shards, 3), np.int32),
         )
-        fill = np.zeros(g.n_shards, np.int32)
-        for i in range(n):
-            s = owner[i]
-            if fill[s] >= bank:
-                raise ValueError(f"bank {s} overflow at placement")
-            r = s * bank + fill[s]
-            fill[s] += 1
-            st.pos[r] = pos[i]
-            st.hp[r] = hp[i]
-            st.atk[r] = atk[i]
-            st.camp[r] = camp[i]
-            st.gid[r] = i
+        if n:
+            order = np.argsort(owner, kind="stable")
+            so = owner[order]
+            starts = np.zeros(g.n_shards, np.int64)
+            starts[1:] = np.cumsum(counts)[:-1]
+            r = so.astype(np.int64) * bank + (np.arange(n) - starts[so])
+            st.pos[r] = pos[order, :2]
+            st.hp[r] = hp[order]
+            st.atk[r] = atk[order]
+            st.camp[r] = camp[order]
+            st.gid[r] = order
             st.active[r] = True
         self.bank_size = bank
         sh = NamedSharding(self.mesh, P(self.axis))
@@ -352,12 +441,12 @@ class SpatialWorld:
         body = partial(_spatial_body, g, self.axis)
         row = P(self.axis)
         rep = P()
-        smapped = jax.shard_map(
+        smapped = _shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(row, row, row, row, row, row, row, rep),
-            out_specs=(row, row, row, row, row, row, row, row),
-            check_vma=False,
+            in_specs=(row,) * 13 + (rep,),
+            out_specs=(row,) * 14,
+            **_SM_KW,
         )
         return jax.jit(smapped)
 
@@ -367,10 +456,7 @@ class SpatialWorld:
         st = self.state
         for _ in range(n):
             t = jnp.int32(self.tick_count)
-            *banks, stats = self._step(
-                st.pos, st.hp, st.atk, st.camp, st.gid, st.died,
-                st.active, t
-            )
+            *banks, stats = self._step(*st, t)
             st = SpatialState(*banks)
             self.tick_count += 1
         self.state = st
@@ -379,10 +465,15 @@ class SpatialWorld:
         # budget (the counters alone are bench-only visibility):
         # - mig_dropped rows left their source bank and found no free
         #   slot at the destination — permanently LOST, always alert
-        # - budget-overflow/misplaced rows retry next tick and bucket
-        #   drops miss one tick of combat — alert above the budget
+        #   (should never fire now that senders clamp to advertised
+        #   destination capacity)
+        # - rows that missed migration (budget or capacity clamp) are a
+        #   SUBSET of `misplaced` — every unmigrated row is still active
+        #   with owner != me when misplaced is counted — so `missed`
+        #   counts misplaced + bucket drops and each affected row once
+        #   (adding mig_overflow on top would double-count)
         lost_forever = int(self.stats_last[:, 2].sum())
-        missed = int(self.stats_last[:, 1].sum()) + int(
+        missed = int(self.stats_last[:, 3].sum()) + int(
             self.stats_last[:, 4:].sum()
         )
         if lost_forever or missed:
@@ -401,6 +492,21 @@ class SpatialWorld:
                     100 * self.overflow_budget,
                     self.stats_last.sum(axis=0).tolist(),
                 )
+
+    # -- Verlet cache visibility ------------------------------------------
+    @property
+    def rebuilds_total(self) -> int:
+        """Max over shards (the pmax vote makes every shard rebuild
+        together, so any shard's counter is the grid's)."""
+        if self.state is None:
+            return 0
+        return int(np.asarray(self.state.cstat)[:, 0].max())
+
+    @property
+    def reuses_total(self) -> int:
+        if self.state is None:
+            return 0
+        return int(np.asarray(self.state.cstat)[:, 1].max())
 
     # -- host observation -------------------------------------------------
     def gather(self):
@@ -427,9 +533,22 @@ class SpatialWorld:
         with np.load(path) as z:
             self.tick_count = int(z["tick"])
             self.bank_size = int(z["bank"])
+            cap = z["pos"].shape[0]
+            # snapshots from before the Verlet cache carry zero caches:
+            # the all-False anchor mask forces a rebuild on the first
+            # tick, so resume trajectories are unchanged
+            fresh = {
+                "vc_pos": np.zeros((cap, 2), np.float32),
+                "vc_active": np.zeros((cap,), bool),
+                "vc_order": np.zeros((cap,), np.int32),
+                "vc_skey": np.zeros((cap,), np.int32),
+                "vc_slot": np.zeros((cap,), np.int32),
+                "cstat": np.zeros((self.geom.n_shards, 3), np.int32),
+            }
             sh = NamedSharding(self.mesh, P(self.axis))
             self.state = SpatialState(
-                *[jax.device_put(z[f], sh) for f in SpatialState._fields]
+                *[jax.device_put(z[f] if f in z.files else fresh[f], sh)
+                  for f in SpatialState._fields]
             )
 
 

@@ -191,6 +191,43 @@ class TelemetryModule(Module):
             "nf_device_bytes", self.census.device_bytes, kind="gauge",
             help="bytes held by live device arrays (best effort)",
         )
+        # Verlet neighbor-cache effectiveness (ops/verlet.py): caches ride
+        # WorldState.aux under "verlet/<grid>"; sampled lazily at scrape
+        # time (np.asarray of three i32 scalars per grid) so the knob
+        # costs nothing when nobody scrapes
+        reg.register_callback(
+            "nf_grid_rebuilds_total", lambda: self._verlet_samples(0),
+            kind="counter",
+            help="cell-table sort+build executions per Verlet-cached grid",
+        )
+        reg.register_callback(
+            "nf_grid_rebuild_interval_ticks",
+            lambda: self._verlet_samples(3), kind="gauge",
+            help="mean ticks between rebuilds (builds+reuses per build)",
+        )
+        reg.register_callback(
+            "nf_grid_staleness_ticks", lambda: self._verlet_samples(2),
+            kind="gauge",
+            help="ticks since each Verlet grid's last rebuild (cache age)",
+        )
+
+    def _verlet_samples(self, which: int) -> Iterable[Tuple[dict, float]]:
+        """which: 0=rebuilds, 1=reuses, 2=age, 3=mean rebuild interval."""
+        import numpy as np
+
+        kernel = self.census.kernel
+        state = getattr(kernel, "state", None)
+        for key, cache in sorted((getattr(state, "aux", None) or {}).items()):
+            if not key.startswith("verlet/"):
+                continue
+            grid = key[len("verlet/"):]
+            if which == 3:
+                reb = float(np.asarray(cache.rebuilds))
+                reu = float(np.asarray(cache.reuses))
+                yield ({"grid": grid}, (reb + reu) / max(reb, 1.0))
+            else:
+                v = (cache.rebuilds, cache.reuses, cache.age)[which]
+                yield ({"grid": grid}, float(np.asarray(v)))
 
     # ------------------------------------------------- module lifecycle
     def after_init(self) -> None:

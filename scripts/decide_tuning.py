@@ -35,7 +35,9 @@ def tick_ms(name: str):
 
 
 def main() -> None:
-    base = tick_ms("r05_tpu_1m.json")
+    base = tick_ms("r06_tpu_1m.json")
+    if base is None:
+        base = tick_ms("r05_tpu_1m.json")
     if base is None:
         print("no baseline 1M capture; not writing tuning", file=sys.stderr)
         return
@@ -66,6 +68,20 @@ def main() -> None:
         tuning["NF_PALLAS"] = "1"
         if best_pallas == pallas_al_ms and pallas_al_ms != pallas_ms:
             tuning["NF_PALLAS_ALIGN"] = "128"
+
+    # Verlet skin (ops/verlet.py): the harvest queue captures the 1M tick
+    # at skins 1/2/4; the fastest capture that beats the margin elects
+    # NF_VERLET_SKIN.  A too-large skin loses through bucket inflation
+    # (cell_size >= radius + skin), a too-small one through rebuild rate,
+    # so this is a measured election, not a formula.
+    best_skin, best_skin_ms = None, base * MARGIN
+    for skin in ("1", "2", "4"):
+        ms = tick_ms(f"r06_tpu_1m_verlet{skin}.json")
+        detail[f"verlet{skin}_tick_ms"] = ms
+        if ms is not None and ms < best_skin_ms:
+            best_skin, best_skin_ms = skin, ms
+    if best_skin is not None:
+        tuning["NF_VERLET_SKIN"] = best_skin
 
     out = {"env": tuning, "detail": detail}
     with open(os.path.join(RUNS, "tuning.json"), "w") as f:

@@ -165,6 +165,44 @@ def main() -> None:
         build(pos, alive, vic_feats, attacking, att_feats)
     )
 
+    # -- Verlet cache passes (ops/verlet.py): what a rebuild tick, a reuse
+    # vote, and the sort-free table replay each cost on this geometry ---------
+    from noahgameframe_tpu.ops.verlet import (
+        full_table as v_full,
+        init_cache,
+        refresh,
+        sub_table as v_sub,
+    )
+
+    skin = 2.0  # representative; geometry stays the bench world's own
+    fresh = init_cache(cap)  # all-False anchor: every refresh rebuilds
+    timed(
+        "verlet_rebuild",
+        jax.jit(lambda c, p, al: refresh(c, p, al, cell_size, width, bucket,
+                                         skin)),
+        fresh, pos, alive,
+    )
+    warm, _ = jax.block_until_ready(
+        jax.jit(lambda c, p, al: refresh(c, p, al, cell_size, width, bucket,
+                                         skin))(fresh, pos, alive)
+    )
+    timed(
+        "verlet_reuse",  # anchored at these exact positions: zero motion
+        jax.jit(lambda c, p, al: refresh(c, p, al, cell_size, width, bucket,
+                                         skin)),
+        warm, pos, alive,
+    )
+    timed(
+        "verlet_cached_tables",  # the payload replay both tables run on a
+        jax.jit(                 # reuse tick — the argsort-free build half
+            lambda c, al, vf, am, af: (
+                v_full(c, vf, al, n_cells, cell_size, width, bucket),
+                v_sub(c, am, af, n_cells, cell_size, width, att_bucket),
+            )
+        ),
+        warm, alive, vic_feats, attacking, att_feats,
+    )
+
     # -- payload scatter / pull gather in isolation ---------------------------
     dump = n_cells * bucket
     occ = jnp.concatenate([vic_feats, jnp.ones((cap, 1), f32)], -1)

@@ -37,6 +37,12 @@ def main() -> None:
                     help="spatial side only (the global sort at 4M on a "
                          "virtual mesh costs minutes/tick; the 512k "
                          "artifact already ranks the two)")
+    ap.add_argument("--skin", type=float, default=0.0,
+                    help="Verlet skin (ops/verlet.py): > 0 inflates the "
+                         "cell to radius + skin and gates the per-shard "
+                         "argsort on displacement; the global reference "
+                         "runs the SAME inflated geometry so parity stays "
+                         "bit-exact")
     args = ap.parse_args()
 
     from noahgameframe_tpu.utils.platform import force_cpu, init_compile_cache
@@ -59,9 +65,12 @@ def main() -> None:
 
     n = args.entities
     # benchmark density (~0.4/unit^2), cell 4.0 — same recipe as
-    # game.world.build_benchmark_world
+    # game.world.build_benchmark_world.  A Verlet skin inflates the cell
+    # to radius + skin (the 3x3 stencil must cover the true radius from
+    # positions up to skin/2 stale).
+    radius = 4.0
     extent = max(64.0, float(np.sqrt(n / 0.4)))
-    cell = 4.0
+    cell = radius + args.skin if args.skin > 0.0 else 4.0
     width = max(1, int(extent / cell))
     width -= width % args.shards  # slab-divisible
     extent = width * cell
@@ -72,8 +81,9 @@ def main() -> None:
     att_bucket = auto_bucket(max(1, n // 30), width, lo=4, align=2) + 4
     geom = SpatialGeom(
         extent=extent, cell_size=cell, width=width, n_shards=args.shards,
-        bucket=bucket, att_bucket=att_bucket, radius=4.0,
+        bucket=bucket, att_bucket=att_bucket, radius=radius,
         mig_budget=max(1024, n // 64), speed=1.0, attack_period=30,
+        skin=args.skin,
     )
 
     rng = np.random.default_rng(42)
@@ -114,6 +124,12 @@ def main() -> None:
             world.stats_last.sum(axis=0),
         )
     }
+    if args.skin > 0.0:
+        out["verlet"] = {
+            "skin": args.skin,
+            "rebuilds": world.rebuilds_total,
+            "reuses": world.reuses_total,
+        }
     sp_hp_total = sum(h for _, _, h in world.gather().values())
     spatial_ticks_total = world.tick_count
     if args.skip_global:

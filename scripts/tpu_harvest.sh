@@ -97,6 +97,17 @@ while :; do
   run_item b1m_pallas_al 1800 env NF_PALLAS=1 NF_PALLAS_ALIGN=128 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
     && save_json b1m_pallas_al bench_runs/r05_tpu_1m_pallas_aligned.json
 
+  # 5c. round-6 baseline + Verlet-skin A/B at 1M (ops/verlet.py): the
+  #     skin trades argsort rate against bucket inflation, so the winner
+  #     is elected from measurement (decide_tuning.py -> NF_VERLET_SKIN)
+  run_item b1m_r06 1800 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
+    && save_json b1m_r06 bench_runs/r06_tpu_1m.json
+  for skin in 1 2 4; do
+    run_item b1m_verlet$skin 1800 env NF_VERLET_SKIN=$skin python -u bench.py \
+        --entities 1000000 --ticks 90 --platform tpu \
+      && save_json b1m_verlet$skin bench_runs/r06_tpu_1m_verlet$skin.json
+  done
+
   # promote measured winners into bench_runs/tuning.json (re-runs are
   # idempotent; no-op until the baseline 1M capture exists) so the
   # driver's end-of-round bench uses the fastest measured engine flags
@@ -124,7 +135,7 @@ while :; do
     && save_json b100k_walk bench_runs/r05_tpu_100k_nocombat.json
 
   n_done=$(ls "$STAMPS" | wc -l)
-  if [ "$n_done" -ge 14 ]; then
+  if [ "$n_done" -ge 18 ]; then
     echo "[$(date -u +%H:%M:%S)] queue drained — exiting"
     exit 0
   fi

@@ -20,3 +20,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 assert jax.devices()[0].platform == "cpu", "tests must run on the CPU mesh"
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; the long soaks opt out via this mark
+    config.addinivalue_line(
+        "markers", "slow: long soak tests excluded from the tier-1 run"
+    )

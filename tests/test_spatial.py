@@ -129,41 +129,66 @@ def test_spatial_migration_budget_overflow_counts():
     assert world.overflow_alerts > 0, "breach must raise the alert counter"
 
 
-def test_spatial_bank_full_drops_are_counted():
-    """If a destination bank has no free slot, the migrant is dropped
-    and counted (mig_dropped), not silently lost from accounting."""
+def _teleport_gid(world, g, xy):
+    """Host-side surgery: move gid g's bank row to world position xy."""
+    st = world.state
+    act = np.asarray(st.active)
+    gids = np.asarray(st.gid)
+    r = next(int(i) for i in np.flatnonzero(act) if int(gids[i]) == g)
+    newpos = np.asarray(st.pos).copy()
+    newpos[r] = xy
+    world.state = st._replace(pos=jax.device_put(
+        jnp.asarray(newpos), st.pos.sharding
+    ))
+
+
+def test_spatial_bank_full_row_retries_never_destroyed():
+    """Migration-loss regression: a migrant whose destination bank has no
+    free slot STAYS HOME and retries next tick — the sender clamps to the
+    destination's advertised free-slot count, so no row is ever cleared
+    from its source bank without a slot waiting.  mig_dropped is now a
+    should-never-fire assertion counter."""
     geom = SpatialGeom(
         extent=64.0, cell_size=4.0, width=16, n_shards=2,
         bucket=64, att_bucket=8, radius=4.0, mig_budget=64,
         speed=0.0, attack_period=97,
     )
-    # all 8 rows on shard 0, banks sized exactly 8: shard 1's bank is
-    # FULL of... nothing — bank_size 8 leaves shard 1 all-free.  Fill
-    # shard 1 by placing 8 rows there too, then force one shard-0 row
-    # across the boundary by teleporting it (host-side surgery).
+    # 2 rows in slab 0 (bank 8: room to spare), 8 rows in slab 1 (bank
+    # exactly FULL).  Teleporting a slab-0 row into slab 1 makes it want
+    # to migrate into a full bank.
     rng = np.random.default_rng(0)
     pos = np.vstack([
-        rng.uniform([1, 1], [62, 30], (8, 2)),    # slab 0
-        rng.uniform([1, 33], [62, 62], (8, 2)),   # slab 1
+        rng.uniform([1, 1], [62, 30], (2, 2)),    # slab 0
+        rng.uniform([1, 33], [62, 62], (8, 2)),   # slab 1 — fills bank 1
     ]).astype(np.float32)
-    hp = np.full(16, 100, np.int32)
-    atk = np.full(16, 5, np.int32)
-    camp = (np.arange(16) % 2).astype(np.int32)
+    hp = np.full(10, 100, np.int32)
+    atk = np.full(10, 5, np.int32)
+    camp = (np.arange(10) % 2).astype(np.int32)
     world = SpatialWorld(geom, bank_size=8)
     world.place(pos, hp, atk, camp)
-    st = world.state
-    # teleport shard-0 row 0 into slab 1 (y > 32): next tick it must
-    # migrate, but shard 1's bank (8/8 occupied) has no free slot
-    newpos = np.asarray(st.pos).copy()
-    newpos[0] = [10.0, 50.0]
-    world.state = st._replace(pos=jax.device_put(
-        jnp.asarray(newpos), st.pos.sharding
-    ))
+    _teleport_gid(world, 0, [10.0, 50.0])  # wants slab 1 (full)
     world.step()
-    assert world.stats_last[:, 2].sum() == 1, world.stats_last
-    # the row is gone from shard 0 (it was sent) — by design the drop
-    # is visible in accounting, mirroring cell-overflow semantics
-    assert len(world.gather()) == 15
+    # destination full: clamped (mig_overflow), still awaiting retry
+    # (misplaced), NOT destroyed, and the assertion counter is silent
+    assert world.stats_last[:, 2].sum() == 0, world.stats_last
+    assert world.stats_last[:, 1].sum() == 1, world.stats_last
+    assert world.stats_last[:, 3].sum() == 1, world.stats_last
+    assert len(world.gather()) == 10
+    # free a slot on shard 1 by moving one of its rows into slab 0; the
+    # stranded row's retry then succeeds (capacity is advertised before
+    # a shard's own outbound clearing, so the slot is visible one tick
+    # after it frees)
+    _teleport_gid(world, 2, [10.0, 10.0])
+    world.step()   # gid 2 migrates down; gid 0 still blocked this tick
+    assert world.stats_last[:, 0].sum() == 1, world.stats_last
+    assert world.stats_last[:, 2].sum() == 0, world.stats_last
+    world.step()   # retry lands: gid 0 migrates into the freed slot
+    assert world.stats_last[:, 0].sum() == 1, world.stats_last
+    assert world.stats_last[:, 1:4].sum() == 0, world.stats_last
+    got = world.gather()
+    assert len(got) == 10
+    # every gid exists exactly once and gid 0 kept its position
+    assert got[0][:2] == (10.0, 50.0), got[0]
 
 
 def test_spatial_stranded_row_hops_home():

@@ -69,6 +69,28 @@ def test_aligned_pallas_promotes_align_flag(tmp_path, capsys):
     assert got["env"]["NF_PALLAS_ALIGN"] == "128"
 
 
+def test_verlet_skin_best_variant_wins(tmp_path, capsys):
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r06_tpu_1m_verlet1.json", 90.0)
+    _w(tmp_path, "r06_tpu_1m_verlet2.json", 70.0)
+    _w(tmp_path, "r06_tpu_1m_verlet4.json", 98.0)  # within margin: loses
+    got = _run(mod, capsys)
+    assert got["env"] == {"NF_VERLET_SKIN": "2"}
+
+
+def test_r06_baseline_preferred_over_r05(tmp_path, capsys):
+    """A fresh r06 baseline supersedes the archived r05 one — electing
+    against a stale baseline would promote phantom wins."""
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 200.0)
+    _w(tmp_path, "r06_tpu_1m.json", 100.0)
+    _w(tmp_path, "r06_tpu_1m_verlet2.json", 150.0)  # beats r05, not r06
+    got = _run(mod, capsys)
+    assert got["env"] == {}
+    assert got["detail"]["baseline_tick_ms"] == 100.0
+
+
 def test_error_payloads_are_ignored(tmp_path, capsys):
     mod = _load(tmp_path)
     _w(tmp_path, "r05_tpu_1m.json", 100.0)

@@ -1,0 +1,389 @@
+"""Verlet-cached cell tables (ops/verlet.py): displacement-gated rebuild.
+
+The contract under test: with cell_size >= radius + skin, a world ticked
+with the Verlet cache enabled is BIT-IDENTICAL to the same world ticked
+with rebuild-every-tick — on the same inflated geometry (the cache only
+ever skips the argsort, never changes which candidate pairs pass the
+true-radius mask), and across the single-device kernel AND the 8-device
+spatial mesh.  Plus the trigger arithmetic at the exact reuse boundary
+`2 * displacement == skin` (must rebuild: reuse is proven only for
+strictly less)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from noahgameframe_tpu.ops.stencil import build_cell_table_pair
+from noahgameframe_tpu.ops.verlet import (
+    full_table,
+    init_cache,
+    need_rebuild,
+    refresh,
+    skin_from_env,
+    sub_table,
+)
+
+
+def _anchored_cache(pos, active, cell_size=4.0, width=8, bucket=8, skin=1.0):
+    cache, rebuilt = refresh(
+        init_cache(pos.shape[0]), pos, active, cell_size, width, bucket, skin
+    )
+    assert int(rebuilt) == 1  # a fresh cache always builds
+    return cache
+
+
+# ---------------------------------------------------------------- trigger
+
+def test_rebuild_boundary_exact_half_skin():
+    """disp == skin/2 (2*disp == skin) MUST rebuild; disp just under
+    reuses.  The proof covers strictly-less-than only, so the boundary
+    itself takes the expensive branch."""
+    pos = jnp.array([[4.0, 4.0], [20.0, 20.0]], jnp.float32)
+    active = jnp.ones(2, bool)
+    skin = 1.0
+    cache = _anchored_cache(pos, active, skin=skin)
+
+    moved = pos.at[0, 0].add(skin / 2.0)  # exactly 2*disp == skin
+    assert bool(need_rebuild(cache, moved, active, skin))
+
+    almost = pos.at[0, 0].add(skin / 2.0 - 1e-3)
+    assert not bool(need_rebuild(cache, almost, active, skin))
+
+    # the trigger uses euclidean displacement, not per-axis (f32 rounding
+    # puts the exact diagonal boundary one ulp under, so nudge past it)
+    diag = pos.at[0].add(jnp.float32(skin / 2.0 + 1e-3) / jnp.sqrt(2.0))
+    assert bool(need_rebuild(cache, diag, active, skin))
+    under_diag = pos.at[0].add(jnp.float32(skin / 2.0 - 1e-3) / jnp.sqrt(2.0))
+    assert not bool(need_rebuild(cache, under_diag, active, skin))
+
+
+def test_rebuild_on_arrival_but_not_departure():
+    """A row the anchor never binned coming alive (spawn/respawn/
+    migration-in) invalidates the cache even with zero displacement — a
+    stale table would hide it.  A row merely LEAVING does not: the
+    payload replay dumps now-inactive rows, which is exactly what a
+    fresh build of the shrunken set would produce."""
+    pos = jnp.array([[4.0, 4.0], [20.0, 20.0]], jnp.float32)
+    active = jnp.ones(2, bool)
+    cache = _anchored_cache(pos, active)
+    assert not bool(need_rebuild(cache, pos, active, 1.0))
+    # departure only: reuse stays valid
+    assert not bool(need_rebuild(cache, pos, active.at[1].set(False), 1.0))
+    # a row dead at anchor time coming alive triggers
+    cache2 = _anchored_cache(pos, active.at[1].set(False))
+    assert bool(need_rebuild(cache2, pos, active, 1.0))
+
+
+def test_dead_rows_do_not_count_displacement():
+    """Displacement of rows not alive in both anchor and present is
+    ignored (a corpse teleporting to a respawn point must not thrash the
+    cache)."""
+    pos = jnp.array([[4.0, 4.0], [20.0, 20.0]], jnp.float32)
+    active = jnp.array([True, False])
+    cache = _anchored_cache(pos, active)
+    moved = pos.at[1].set(jnp.float32([500.0, 500.0]))
+    assert not bool(need_rebuild(cache, moved, active, 1.0))
+
+
+def test_refresh_counters_and_reuse():
+    pos = jnp.array([[4.0, 4.0], [20.0, 20.0]], jnp.float32)
+    active = jnp.ones(2, bool)
+    cache = _anchored_cache(pos, active, skin=2.0)
+    for age in (1, 2, 3):
+        cache, rebuilt = refresh(
+            cache, pos, active, 4.0, 8, 8, 2.0
+        )
+        assert int(rebuilt) == 0
+        assert int(cache.age) == age
+    assert int(cache.rebuilds) == 1 and int(cache.reuses) == 3
+    # push past the skin: rebuild, age resets
+    cache, rebuilt = refresh(
+        cache, pos + 1.5, active, 4.0, 8, 8, 2.0
+    )
+    assert int(rebuilt) == 1 and int(cache.age) == 0
+    assert int(cache.rebuilds) == 2
+
+
+# ------------------------------------------------------- table bit-parity
+
+def test_cached_tables_match_pair_builder():
+    """full_table/sub_table through a fresh cache reproduce
+    build_cell_table_pair exactly (payload, slot_of, dropped) — same
+    argsort, same slots, same scatter."""
+    rng = np.random.default_rng(5)
+    n, width, cell = 257, 8, 4.0
+    pos = jnp.asarray(rng.uniform(0, width * cell, (n, 2)).astype(np.float32))
+    active = jnp.asarray(rng.random(n) < 0.8)
+    sub = jnp.asarray(rng.random(n) < 0.3) & active
+    feats = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+    sfeats = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
+
+    ref_full, ref_sub = build_cell_table_pair(
+        pos, active, feats, sub, sfeats, cell, width, 12, 8
+    )
+    cache, _ = refresh(init_cache(n), pos, active, cell, width, 12, 1.0)
+    got_full = full_table(cache, feats, active, width * width, cell, width, 12)
+    got_sub = sub_table(cache, sub, sfeats, width * width, cell, width, 8)
+    for ref, got in ((ref_full, got_full), (ref_sub, got_sub)):
+        np.testing.assert_array_equal(np.asarray(ref.payload),
+                                      np.asarray(got.payload))
+        np.testing.assert_array_equal(np.asarray(ref.slot_of),
+                                      np.asarray(got.slot_of))
+        assert int(ref.dropped) == int(got.dropped)
+
+
+def test_sub_table_reuse_tick_still_exact():
+    """After small motion (reuse branch), sub_table with a fresh subset
+    mask must equal the pair builder run against the ANCHOR binning —
+    the cached order is the anchor's, only features/membership are new."""
+    rng = np.random.default_rng(9)
+    n, width, cell = 181, 8, 4.0
+    pos0 = jnp.asarray(rng.uniform(1, width * cell - 1, (n, 2)).astype(np.float32))
+    active = jnp.ones(n, bool)
+    cache, _ = refresh(init_cache(n), pos0, active, cell, width, 12, 2.0)
+    # drift under skin/2, then a different subset fires
+    pos1 = pos0 + jnp.asarray(
+        rng.uniform(-0.4, 0.4, (n, 2)).astype(np.float32)
+    )
+    cache, rebuilt = refresh(cache, pos1, active, cell, width, 12, 2.0)
+    assert int(rebuilt) == 0
+    sub = jnp.asarray(rng.random(n) < 0.25)
+    sfeats = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
+    got = sub_table(cache, sub, sfeats, width * width, cell, width, 8)
+    # oracle: bin at ANCHOR positions (what the cache preserves)
+    _, ref = build_cell_table_pair(
+        pos0, active, jnp.zeros((n, 1), jnp.float32), sub, sfeats,
+        cell, width, 12, 8,
+    )
+    np.testing.assert_array_equal(np.asarray(ref.payload),
+                                  np.asarray(got.payload))
+
+
+def test_skin_from_env(monkeypatch):
+    monkeypatch.delenv("NF_VERLET_SKIN", raising=False)
+    assert skin_from_env() == 0.0
+    monkeypatch.setenv("NF_VERLET_SKIN", "2.5")
+    assert skin_from_env() == 2.5
+    monkeypatch.setenv("NF_VERLET_SKIN", "banana")
+    assert skin_from_env() == 0.0
+    assert skin_from_env(1.5) == 1.5
+
+
+# ------------------------------------------------- single-device tick soak
+
+def _soak_world(skin):
+    from noahgameframe_tpu.game.world import GameWorld, WorldConfig
+
+    # aoi_bucket 64: parity demands ZERO bucket drops in both geometries
+    # (anchor and current binnings drop DIFFERENT rows when a cell
+    # overflows); 64 is generous for ~2k NPCs on either grid
+    w = GameWorld(WorldConfig(
+        npc_capacity=2048, extent=96.0, seed=11, middleware=False,
+        aoi_bucket=64, verlet_skin=skin,
+    ))
+    w.start()
+    w.scene.create_scene(1, width=96.0)
+    w.seed_npcs(2000)
+    return w
+
+
+@pytest.mark.slow
+def test_device_tick_soak_bit_identical_120():
+    """>=120 fused ticks: the Verlet-gated kernel tick produces the exact
+    same world state as rebuild-every-tick on the same inflated geometry,
+    and actually reused the cache (else the test proves nothing)."""
+    skin = 2.0
+    w_on = _soak_world(skin)
+    w_off = _soak_world(None)
+    # same INFLATED geometry for the baseline: parity is a statement
+    # about skipping the sort, not about the grid layout
+    assert w_on.combat.verlet_skin == skin
+    w_off.combat.verlet_skin = 0.0
+    w_off.combat.cell_size = w_on.combat.cell_size
+    w_off.combat.width = w_on.combat.width
+
+    for w in (w_on, w_off):
+        w.kernel.run_device(120)
+        w.kernel.tick()  # reconcile + fetch the counter bank
+    cache = w_on.kernel.state.aux["verlet/NPC"]
+    assert int(cache.rebuilds) >= 1
+    assert int(cache.reuses) > 30, "skin 2.0 should amortize most ticks"
+
+    on = jax.tree.map(np.asarray, w_on.kernel.state.classes["NPC"])
+    off = jax.tree.map(np.asarray, w_off.kernel.state.classes["NPC"])
+    flat_on, tree_on = jax.tree.flatten(on)
+    flat_off, tree_off = jax.tree.flatten(off)
+    assert tree_on == tree_off
+    for a, b in zip(flat_on, flat_off):
+        np.testing.assert_array_equal(a, b)
+    # the on-device rebuild counters surfaced through the counter bank
+    assert "grid_rebuilds" in w_on.kernel.counter_totals
+
+
+def test_device_tick_short_parity_and_counters():
+    """A fast (non-slow) slice of the soak: 24 ticks, same assertions —
+    keeps the contract in the default tier-1 run."""
+    skin = 2.0
+    w_on = _soak_world(skin)
+    w_off = _soak_world(None)
+    w_off.combat.verlet_skin = 0.0
+    w_off.combat.cell_size = w_on.combat.cell_size
+    w_off.combat.width = w_on.combat.width
+    for w in (w_on, w_off):
+        w.kernel.run_device(24)
+        w.kernel.tick()
+    cache = w_on.kernel.state.aux["verlet/NPC"]
+    assert int(cache.reuses) > 0
+    on = jax.tree.map(np.asarray, w_on.kernel.state.classes["NPC"])
+    off = jax.tree.map(np.asarray, w_off.kernel.state.classes["NPC"])
+    for a, b in zip(jax.tree.leaves(on), jax.tree.leaves(off)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------------- 8-shard mesh soak
+
+@pytest.mark.slow
+def test_spatial_mesh_soak_bit_identical_120():
+    """120 ticks on the 8-device slab mesh with the skin on, against the
+    single-device always-rebuild oracle on the SAME inflated geometry:
+    positions and HP bit-identical, and the mesh actually reused its
+    caches (the pmax vote rebuilds all shards together, so reuse ticks
+    exist only when NO entity migrated anywhere — keep speed low)."""
+    from noahgameframe_tpu.parallel.spatial import (
+        SpatialGeom,
+        SpatialWorld,
+        reference_step,
+    )
+
+    geom = SpatialGeom(
+        extent=128.0, cell_size=8.0, width=16, n_shards=8,
+        bucket=48, att_bucket=48, radius=4.0, mig_budget=256,
+        speed=0.1, attack_period=3, skin=4.0,
+    )
+    rng = np.random.default_rng(3)
+    n = 400
+    pos = rng.uniform(1.0, 127.0, (n, 2)).astype(np.float32)
+    hp = np.full(n, 4000, np.int32)
+    atk = rng.integers(5, 20, n).astype(np.int32)
+    camp = (np.arange(n) % 2).astype(np.int32)
+    ticks = 120
+
+    world = SpatialWorld(geom)
+    world.place(pos, hp, atk, camp)
+    for _ in range(ticks):
+        world.step()
+        assert world.stats_last[:, 4:].sum() == 0, world.stats_last
+
+    assert world.reuses_total > 0, "no reuse ticks - soak proves nothing"
+    assert world.rebuilds_total + world.reuses_total == ticks
+
+    gid = jnp.arange(n, dtype=jnp.int32)
+    active = jnp.ones(n, bool)
+    posj, hpj = jnp.asarray(pos), jnp.asarray(hp)
+    diedj = jnp.full(n, -1, jnp.int32)
+    step = jax.jit(lambda p, h, dd, t: reference_step(
+        geom, p, h, jnp.asarray(atk), jnp.asarray(camp), gid, dd, active, t
+    ))
+    for t in range(ticks):
+        posj, hpj, diedj = step(posj, hpj, diedj, jnp.int32(t))
+    ref_pos, ref_hp = np.asarray(posj), np.asarray(hpj)
+
+    got = world.gather()
+    assert len(got) == n
+    for g, (x, y, hp_) in got.items():
+        assert hp_ == int(ref_hp[g]), f"gid {g} hp"
+        np.testing.assert_array_equal(np.float32([x, y]), ref_pos[g])
+
+
+def test_spatial_mesh_short_parity():
+    """Non-slow slice: 20 ticks, 4 shards, same bit-parity contract."""
+    from noahgameframe_tpu.parallel.spatial import (
+        SpatialGeom,
+        SpatialWorld,
+        reference_step,
+    )
+
+    geom = SpatialGeom(
+        extent=128.0, cell_size=8.0, width=16, n_shards=4,
+        bucket=48, att_bucket=48, radius=4.0, mig_budget=256,
+        speed=0.12, attack_period=3, skin=4.0,
+    )
+    rng = np.random.default_rng(4)
+    n = 300
+    pos = rng.uniform(1.0, 127.0, (n, 2)).astype(np.float32)
+    hp = np.full(n, 2000, np.int32)
+    atk = rng.integers(5, 20, n).astype(np.int32)
+    camp = (np.arange(n) % 2).astype(np.int32)
+    world = SpatialWorld(geom)
+    world.place(pos, hp, atk, camp)
+    for _ in range(20):
+        world.step()
+    assert world.reuses_total > 0
+    gid = jnp.arange(n, dtype=jnp.int32)
+    active = jnp.ones(n, bool)
+    posj, hpj = jnp.asarray(pos), jnp.asarray(hp)
+    diedj = jnp.full(n, -1, jnp.int32)
+    step = jax.jit(lambda p, h, dd, t: reference_step(
+        geom, p, h, jnp.asarray(atk), jnp.asarray(camp), gid, dd, active, t
+    ))
+    for t in range(20):
+        posj, hpj, diedj = step(posj, hpj, diedj, jnp.int32(t))
+    ref_hp = np.asarray(hpj)
+    for g, (_, _, hp_) in world.gather().items():
+        assert hp_ == int(ref_hp[g]), f"gid {g}"
+
+
+def test_spatial_skin_needs_inflated_cells():
+    from noahgameframe_tpu.parallel.spatial import SpatialGeom, SpatialWorld
+
+    geom = SpatialGeom(
+        extent=64.0, cell_size=4.0, width=16, n_shards=2,
+        bucket=8, att_bucket=8, radius=4.0, mig_budget=8, skin=2.0,
+    )
+    with pytest.raises(ValueError, match="cell_size"):
+        SpatialWorld(geom)
+
+
+# ---------------------------------------------------- interest cached path
+
+def test_interest_cached_candidates_match_fresh():
+    """visible_candidates_cached returns the same candidate SET as the
+    fresh builder on the same inflated grid (row ordering may differ:
+    slots come from the anchor binning)."""
+    from noahgameframe_tpu.ops.interest import (
+        visible_candidates,
+        visible_candidates_cached,
+    )
+    from noahgameframe_tpu.ops.verlet import init_cache as _ic
+
+    rng = np.random.default_rng(2)
+    n, s = 400, 16
+    radius, skin = 4.0, 2.0
+    cell, width, bucket = radius + skin, 10, 32
+    pos = jnp.asarray(rng.uniform(1, 59, (n, 2)).astype(np.float32))
+    alive = jnp.asarray(rng.random(n) < 0.9)
+    scene = jnp.ones(n, jnp.float32)
+    group = jnp.zeros(n, jnp.float32)
+    obs = jnp.asarray(rng.uniform(1, 59, (s, 2)).astype(np.float32))
+    obs_scene = jnp.ones(s, jnp.float32)
+    obs_group = jnp.zeros(s, jnp.float32)
+    cache = _ic(n)
+    for frame in range(6):
+        moved = jnp.asarray(rng.random(n) < 0.5) & alive
+        fresh = visible_candidates(
+            pos, moved, scene, group, obs, obs_scene, obs_group,
+            radius, cell, width, bucket,
+        )
+        got, cache, _reb = visible_candidates_cached(
+            cache, pos, moved, alive, scene, group, obs, obs_scene,
+            obs_group, radius, cell, width, bucket, skin,
+        )
+        for o in range(s):
+            a = set(np.asarray(fresh.rows[o])[np.asarray(fresh.ok[o])].tolist())
+            b = set(np.asarray(got.rows[o])[np.asarray(got.ok[o])].tolist())
+            assert a == b, f"frame {frame} observer {o}"
+        pos = pos + jnp.asarray(
+            rng.uniform(-0.3, 0.3, (n, 2)).astype(np.float32)
+        )
+        pos = jnp.clip(pos, 1.0, 59.0)
