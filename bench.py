@@ -316,7 +316,7 @@ def run_served(args) -> dict:
     world = build_benchmark_world(
         n,
         combat=not args.no_combat,
-        seed=42,
+        seed=args.seed,
         player_capacity=next_pow2(args.sessions + 8, lo=64),
     )
     role = GameRole(
@@ -382,6 +382,7 @@ def run_served(args) -> dict:
         "detail": {
             "entities": n,
             "ticks": args.ticks,
+            "seed": args.seed,
             "sessions": n_sessions,
             "elapsed_s": round(elapsed, 4),
             "frame_ms_p50": p50,
@@ -410,7 +411,8 @@ def run_sharded(args) -> dict:
     from noahgameframe_tpu.parallel import ShardedKernel
 
     n = args.entities
-    world = build_benchmark_world(n, combat=not args.no_combat, seed=42)
+    world = build_benchmark_world(n, combat=not args.no_combat,
+                                  seed=args.seed)
     sk = ShardedKernel(world.kernel, n_devices=args.sharded)
     sk.place()
     k = world.kernel
@@ -435,6 +437,7 @@ def run_sharded(args) -> dict:
         "detail": {
             "entities": n,
             "ticks": args.ticks,
+            "seed": args.seed,
             "devices": args.sharded,
             "mesh": str(dict(sk.mesh.shape)),
             "elapsed_s": round(dt, 4),
@@ -458,7 +461,8 @@ def run_bench(args) -> dict:
 
     init_compile_cache()
     n = args.entities
-    world = build_benchmark_world(n, combat=not args.no_combat, seed=42)
+    world = build_benchmark_world(n, combat=not args.no_combat,
+                                  seed=args.seed)
     k = world.kernel
 
     # compile + warm up (the trip count is a traced scalar: this ONE
@@ -560,6 +564,7 @@ def run_bench(args) -> dict:
         "detail": {
             "entities": n,
             "ticks": args.ticks,
+            "seed": args.seed,
             "elapsed_s": round(dt, 4),
             "compile_and_warmup_s": round(compile_s, 2),
             "ticks_per_s": round(ticks_per_s, 2),
@@ -581,14 +586,10 @@ def run_bench(args) -> dict:
             "att_overflow_max": att_drop,
             # on-device counter bank from the reconciling tick above
             "tick_counters": dict(k.last_counters),
-            **(
-                {
-                    "verlet": verlet,
-                    "verlet_skin": skin_from_env(),
-                }
-                if verlet
-                else {}
-            ),
+            # elected skin, whether or not Verlet caches engaged — a run
+            # is only reproducible with the same (seed, skin) pair
+            "verlet_skin": skin_from_env(),
+            **({"verlet": verlet} if verlet else {}),
         },
     }
 
@@ -689,6 +690,9 @@ def _run_ladder(probe_note, serve_args) -> None:
             # Both fan-out modes ride along: group broadcast (reference
             # parity) and the per-session interest stream (round-3 item 3)
             extra = [a for a in serve_args if a == "--no-combat"]
+            if "--seed" in serve_args:
+                i = serve_args.index("--seed")
+                extra += serve_args[i:i + 2]
             payload.setdefault("detail", {})["served"] = _served_probe(extra)
             payload["detail"]["served_interest"] = _served_probe(
                 extra + ["--interest-radius", "8.0"]
@@ -720,6 +724,12 @@ def main() -> None:
     ap.add_argument("--entities", type=int, default=None)
     ap.add_argument("--ticks", type=int, default=None)
     ap.add_argument("--no-combat", action="store_true")
+    ap.add_argument(
+        "--seed", type=int, default=42,
+        help="world seed for the benchmark population; recorded in the "
+             "BENCH json so any run can be reproduced (or replayed) "
+             "exactly",
+    )
     ap.add_argument(
         "--served", action="store_true",
         help="measure the served path (tick + diff flush + fan-out) "
@@ -821,6 +831,7 @@ def main() -> None:
             serve = ["--served", "--sessions", str(args.sessions)] if args.served else []
             if args.no_combat:
                 serve.append("--no-combat")
+            serve += ["--seed", str(args.seed)]
             _run_ladder(note, serve)
             return
     # platform == "tpu": let the default (axon) backend initialise in-process

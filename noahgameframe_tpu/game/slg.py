@@ -57,6 +57,7 @@ class SLGBuildingModule(Module):
         upgrade_s: float = 20.0,  # reference nNeedTime = 20
         boost_factor: float = 0.5,
         produce_interval_s: float = 50.0,  # reference nTime = 50
+        wall_base: float = 0.0,
     ) -> None:
         super().__init__()
         self.pack = pack
@@ -65,7 +66,7 @@ class SLGBuildingModule(Module):
         self.produce_interval_s = produce_interval_s
         self.collect_amount = 10  # per building level, per collect interval
         self.collect_interval_s = 10.0  # accrual period for RESOURCE yield
-        self._wall_base: Optional[float] = None  # set on first _now()
+        self.wall_base: float = float(wall_base)  # see _now()
         # due-tick heap over (tick, owner, kind, rec_row); the record is
         # the source of truth — entries are validated when they fire
         self._due: List[Tuple[int, Guid, str, int]] = []
@@ -85,23 +86,21 @@ class SLGBuildingModule(Module):
         self.kernel.register_class_event(on_player, "Player")
 
     # ------------------------------------------------------------ helpers
-    # Time unit: WALL-ANCHORED sim seconds — wall clock at module start
-    # plus sim time (tick x dt).  Absolute seconds persist in the record
-    # (the reference stores GetNowTime() the same way,
-    # NFCSLGBuildingModule.cpp:121-124), so a player blob saved in one
-    # process resolves correctly in a freshly-started one (tick counters
-    # restart at 0; wall time doesn't), and server downtime counts toward
-    # completion (offline progression).  Fits int32 like the reference's.
+    # Time unit: ANCHORED sim seconds — `wall_base` plus sim time
+    # (tick x dt).  Absolute seconds persist in the record (the reference
+    # stores GetNowTime() the same way, NFCSLGBuildingModule.cpp:121-124).
+    # The anchor defaults to 0 (pure logical time), keeping every value
+    # a function of journaled inputs for record/replay; a deployment that
+    # wants offline progression across restarts (downtime counting toward
+    # completion) injects wall_base=time.time() at construction — the one
+    # wall read then happens outside the simulation layer and is itself
+    # journalable.  Fits int32 like the reference's.
     def _dur_s(self, seconds: float) -> int:
         """Duration in whole seconds (floor 1 — timers must fire)."""
         return max(1, int(round(seconds)))
 
     def _now(self) -> int:
-        if self._wall_base is None:
-            import time as _t
-
-            self._wall_base = float(_t.time())
-        return int(self._wall_base
+        return int(self.wall_base
                    + self.kernel.tick_count * self.kernel.schedule.dt)
 
     def _get(self, guid: Guid, row: int, tag: str):
@@ -381,10 +380,11 @@ class SLGBuildingModule(Module):
         k = self.kernel
         now = self._now()
         last = int(self._get(guid, row, "LastCollect"))
-        if last < 1_000_000_000:
-            # stamp from a different time base (unset, or a legacy blob
-            # that stored tick counts): rebase instead of paying out an
-            # epoch's worth of intervals in one call
+        if last < int(self.wall_base):
+            # stamp from a different (earlier) time base — e.g. a legacy
+            # blob that stored tick counts loaded into a wall-anchored
+            # process: rebase instead of paying out an epoch's worth of
+            # intervals in one call
             self._set(guid, row, "LastCollect", now)
             return False
         period = self._dur_s(self.collect_interval_s)

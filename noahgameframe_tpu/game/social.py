@@ -220,7 +220,7 @@ class Mail:
     body: str
     gold: int = 0
     items: Dict[str, int] = dataclasses.field(default_factory=dict)
-    sent_at: float = 0.0
+    sent_at: float = 0.0  # logical time: kernel tick at send
     read: bool = False
     drawn: bool = False
 
@@ -245,8 +245,12 @@ class MailModule(Module):
 
     def send(self, to_account: str, sender: str, title: str, body: str = "",
              gold: int = 0, items: Optional[Dict[str, int]] = None) -> int:
+        # stamp with the kernel tick, not the wall clock: mail state must
+        # be a pure function of journaled inputs for record/replay
+        k = self.kernel
+        sent_at = float(k.tick_count) if k is not None else 0.0
         mail = Mail(self._next_id, sender, title, body, gold,
-                    dict(items or {}), _time.time())
+                    dict(items or {}), sent_at)
         self._next_id += 1
         box = self._boxes.setdefault(to_account, [])
         box.append(mail)

@@ -58,6 +58,55 @@ RecordDiffFn = Callable[[str, str, np.ndarray], None]
 
 REC_NONE, REC_ADDED, REC_REMOVED, REC_UPDATED = 0, 1, 2, 3
 
+# multiplier for the rolling state-digest fold (odd, so it is invertible
+# mod 2^32 and single-bit flips diffuse instead of cancelling)
+_DIGEST_MULT = 1000003
+
+
+def _digest_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """Reinterpret any bank dtype as uint32 words, bit-exactly for f32
+    (a digest over *rounded* floats would call two bitwise-different
+    states equal — the one thing replay must never do)."""
+    if x.dtype == jnp.bool_:
+        return x.astype(jnp.uint32)
+    if x.dtype in (jnp.float32, jnp.int32):
+        return jax.lax.bitcast_convert_type(x, jnp.uint32)
+    return x.astype(jnp.uint32)
+
+
+def state_digest(state, class_order: Sequence[str]) -> jnp.ndarray:
+    """One uint32 digest of the whole device-resident world.
+
+    Position-weighted modular sums per bank, folded across banks with a
+    rolling multiply — pure uint32 arithmetic, so the reduction is
+    associative/commutative (wraparound add) and the result is
+    bit-identical across backends and shardings whenever the state
+    arrays are.  WorldState.aux is deliberately EXCLUDED: caches there
+    (Verlet tables) are rebuilt from scratch on resume and masked out of
+    results, so their contents differ between a live run and a
+    checkpoint-restored replay of the same world.
+    """
+
+    def fold(acc: jnp.ndarray, arr: jnp.ndarray) -> jnp.ndarray:
+        x = _digest_u32(arr).ravel()
+        w = jnp.arange(x.shape[0], dtype=jnp.uint32) * 2 + 1
+        return acc * jnp.uint32(_DIGEST_MULT) + jnp.sum(x * w, dtype=jnp.uint32)
+
+    acc = jnp.uint32(0x9E3779B9)
+    acc = fold(acc, state.tick)
+    acc = fold(acc, state.rng)
+    for cname in class_order:
+        cs = state.classes[cname]
+        for arr in (cs.i32, cs.f32, cs.vec, cs.alive,
+                    cs.timers.next_fire, cs.timers.interval,
+                    cs.timers.remain, cs.timers.active):
+            acc = fold(acc, arr)
+        for rname in sorted(cs.records):
+            rec = cs.records[rname]
+            for arr in (rec.i32, rec.f32, rec.vec, rec.used):
+                acc = fold(acc, arr)
+    return acc
+
 
 class TickCtx:
     """Per-tick context handed to device phases during tracing."""
@@ -191,6 +240,11 @@ class Kernel(Module):
         self._counter_names: Tuple[str, ...] = ()
         self.last_counters: Dict[str, int] = {}  # latest observed tick
         self.counter_totals: Dict[str, int] = {}  # cumulative over tick()s
+        # when set, the tick folds a uint32 digest of the post-tick state
+        # into the counter bank ("state_digest") — the flight recorder's
+        # per-tick fingerprint, riding the summary fetch at zero extra
+        # syncs.  Flip via enable_digest() so the tick is retraced.
+        self.digest_enabled = False
         # optional telemetry.SpanTracer for host-side tick stage spans
         # (dispatch / summary fetch / post-tick fan-out); None = no cost
         self.tracer = None
@@ -346,6 +400,12 @@ class Kernel(Module):
         counters["diff_cells"] = sum(diff_count.values(), zero)
         counters["rec_diff_cells"] = sum(rec_diff_count.values(), zero)
         counters["events_fired"] = sum(ev_counts, zero)
+        if self.digest_enabled:
+            # post-increment state, i.e. exactly what a checkpoint taken
+            # after this tick would capture — replay compares like for like
+            counters["state_digest"] = jax.lax.bitcast_convert_type(
+                state_digest(state, self.store.class_order), jnp.int32
+            )
         self._counter_names = tuple(sorted(counters))
         # ONE packed scalar vector per tick — the only thing the host ever
         # synchronously fetches.  Anything else (masks, params, fired) is
@@ -403,6 +463,14 @@ class Kernel(Module):
             }
             if len(kept) != len(self.state.aux):
                 self.state = self.state.replace(aux=kept)
+
+    def enable_digest(self) -> None:
+        """Turn on the per-tick state digest (flight-recorder fingerprint).
+        A no-op when already on; otherwise the compiled tick is retraced
+        so the counter bank grows the "state_digest" slot."""
+        if not self.digest_enabled:
+            self.digest_enabled = True
+            self.invalidate()
 
     # -- carried aux state ---------------------------------------------------
 
@@ -466,6 +534,8 @@ class Kernel(Module):
             out.counters = {k: int(v) for k, v in zip(names, tail)}
             self.last_counters = dict(out.counters)
             for k, v in out.counters.items():
+                if k == "state_digest":
+                    continue  # a hash; summing it is noise, not a counter
                 self.counter_totals[k] = self.counter_totals.get(k, 0) + v
         with self._span("kernel.post_tick"):
             self._post_tick(out, summary)

@@ -263,3 +263,18 @@ class ChaosDirector:
             for k, v in per_link.items()
             if kind is None or k == kind
         )
+
+    def status(self) -> dict:
+        """The plan spelled out for operators: seed + per-link fault
+        budgets (the FaultPlan patterns) + live injected counts.  The
+        master mounts this on /json and game roles journal it, so any
+        chaos run can be re-derived exactly for replay."""
+        return {
+            "seed": int(self.plan.seed),
+            "links": {
+                pattern: dataclasses.asdict(faults)
+                for pattern, faults in self.plan.links.items()
+            },
+            "default": dataclasses.asdict(self.plan.default),
+            "counts": {link: dict(c) for link, c in self.counts.items()},
+        }

@@ -67,6 +67,10 @@ class _Dispatch:
         self._log = logging.getLogger("nf.net.dispatch")
         self.dropped_msgs = 0  # observability: handler faults survived
         self.counters = counters
+        # flight-recorder seam: when set, sees every event in dispatch
+        # order BEFORE any handler runs (replay/journal.py taps here —
+        # this is the single choke point both endpoints deliver through)
+        self.tap: Optional[Callable[[NetEvent], None]] = None
 
     def on(self, msg_id: int, fn: ReceiveHandler) -> None:
         self._handlers.setdefault(int(msg_id), []).append(fn)
@@ -90,6 +94,8 @@ class _Dispatch:
 
     def feed(self, events: List[NetEvent]) -> None:
         for ev in events:
+            if self.tap is not None:
+                self.tap(ev)
             if ev.kind == EV_MSG:
                 if self.counters is not None:
                     self.counters.count_in(ev.msg_id, len(ev.body))

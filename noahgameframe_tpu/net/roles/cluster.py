@@ -11,6 +11,7 @@ via ``scripts/run_role.py`` with a shared Server.xml.
 
 from __future__ import annotations
 
+import dataclasses
 import time as _time
 from typing import Callable, Dict, List, Optional
 
@@ -172,6 +173,9 @@ class LocalCluster:
         director owns the per-link counters and each fresh transport the
         pool creates is wrapped again."""
         self.chaos = ChaosDirector(plan)
+        # surface the plan: /json shows seed + per-link budgets so any
+        # chaos run is re-derivable for offline replay
+        self.master.chaos_status = self.chaos.status
         for role in self.roles:
             self._chaos_role(role)
         return self.chaos
@@ -198,6 +202,18 @@ class LocalCluster:
                         sd.client, f"{rname}.{key}->{sd.server_id}"
                     )
         role.telemetry.add_chaos_source(director, prefix=f"{rname}.")
+        # flight recorder: a recording game role journals the fault-plan
+        # seed + link budgets as an epoch note (RNG seeds of everything
+        # that can reorder its inputs belong in the journal)
+        note = getattr(role, "journal_note", None)
+        if note is not None:
+            plan = director.plan
+            note(
+                kind="chaos",
+                seed=int(plan.seed),
+                links={p: dataclasses.asdict(f)
+                       for p, f in plan.links.items()},
+            )
 
     # ----------------------------------------------------- kill / revive
     def kill_role(self, role) -> RoleConfig:

@@ -159,6 +159,8 @@ class GameRole(ServerRole):
         checkpoint_dir=None,
         checkpoint_seconds: float = 30.0,
         resume: bool = False,
+        journal_dir=None,
+        journal_segment_bytes: int = 1 << 20,
     ) -> None:
         # (class, prop) diffs with >= batch_sync_min changed rows go out
         # as ONE columnar ACK_BATCH_PROPERTY message per (cell, conn)
@@ -216,6 +218,16 @@ class GameRole(ServerRole):
         self.checkpoint_dir = _Path(checkpoint_dir) if checkpoint_dir else None
         self.checkpoint_seconds = checkpoint_seconds
         self._last_checkpoint = 0.0
+        # flight recorder (replay/journal.py): when a journal dir is
+        # given, every dispatched net event + a per-tick on-device state
+        # digest is logged so the run can be re-executed offline.  The
+        # digest must be baked into the compiled tick, so flip it on
+        # BEFORE anything can trigger the first compile.
+        self.journal = None
+        self._journal_dir = _Path(journal_dir) if journal_dir else None
+        self._journal_segment_bytes = int(journal_segment_bytes)
+        if self._journal_dir is not None:
+            self.kernel.enable_digest()
         super().__init__(config, backend=backend)
         reg = self.telemetry.registry
         self._ckpt_counter = reg.counter(
@@ -320,6 +332,74 @@ class GameRole(ServerRole):
             for cname in self.sync_classes:
                 if self._interest_ok(cname):
                     self.kernel.register_class_event(_mark_dirty, cname)
+        if self._journal_dir is not None:
+            from ...ops.verlet import skin_from_env
+            from ...replay.journal import (
+                JournalWriter,
+                SRC_SERVER,
+                SRC_WORLD,
+            )
+
+            cfg = self.game_world.config
+            self.journal = JournalWriter(
+                self._journal_dir,
+                segment_bytes=self._journal_segment_bytes,
+                meta={
+                    "server_id": config.server_id,
+                    "name": config.name,
+                    "world_seed": cfg.seed,
+                    "dt": cfg.dt,
+                    "start_tick": self.kernel.tick_count,
+                    "resumed": bool(resume),
+                    "verlet_skin": float(skin_from_env()),
+                },
+            )
+            # tap BOTH dispatch choke points: client/proxy traffic on the
+            # listening server, world commands/switches on the world link
+            # — together with the tick marks this is the complete
+            # host→device input stream
+            self.server.dispatch.tap = self._journal_tap(SRC_SERVER)
+            self.world_link.dispatch.tap = self._journal_tap(SRC_WORLD)
+            reg = self.telemetry.registry
+            self._jrn_bytes = reg.counter(
+                "nf_journal_bytes_total", "flight-recorder bytes appended"
+            )
+            self._jrn_segments = reg.counter(
+                "nf_journal_segments_total", "flight-recorder segments opened"
+            )
+            self._jrn_ticks = reg.counter(
+                "nf_journal_ticks_total", "ticks journaled with a digest"
+            )
+            self._jrn_sampled = [0, 0, 0]  # bytes, segments, ticks
+            self._journal_pump_counters()
+
+    def _journal_tap(self, source: int):
+        def tap(ev) -> None:
+            j = self.journal
+            if j is not None:
+                j.event(source, ev.kind, ev.conn_id, ev.msg_id, ev.body)
+        return tap
+
+    def _journal_pump_counters(self) -> None:
+        """Fold the writer's monotonic totals into the registry as
+        deltas (counters only go up; the writer is the source of
+        truth)."""
+        j = self.journal
+        vals = (j.bytes_total, j.segments_total, j.ticks_total)
+        for counter, new, i in zip(
+            (self._jrn_bytes, self._jrn_segments, self._jrn_ticks),
+            vals, range(3),
+        ):
+            d = new - self._jrn_sampled[i]
+            if d:
+                counter.inc(d)
+                self._jrn_sampled[i] = new
+
+    def journal_note(self, **info) -> None:
+        """Drop an epoch marker into the journal (chaos seed + link
+        budgets, config flips) — no-op when not recording."""
+        if self.journal is not None:
+            self.journal.note(info)
 
     def _install(self) -> None:
         s = self.server
@@ -1310,6 +1390,14 @@ class GameRole(ServerRole):
                 self.kernel.tick()
                 pm.frame += 1
                 self._tick_hist.observe(_time.perf_counter() - t0)
+            if self.journal is not None:
+                # closes this tick's input window; the digest rode the
+                # summary fetch the tick already paid for
+                self.journal.tick_mark(
+                    self.kernel.tick_count,
+                    self.kernel.last_counters.get("state_digest", 0),
+                )
+                self._journal_pump_counters()
         # _interest_dirty alone must also trigger a flush: a destroy with
         # no property diff still changes visible sets (gone lists)
         if self._changed or self._rec_changed or self._interest_dirty:
@@ -1340,7 +1428,19 @@ class GameRole(ServerRole):
         """Write one atomic whole-world checkpoint; returns its path."""
         self.game_world.save(self.checkpoint_dir)
         self._ckpt_counter.inc()
+        if self.journal is not None:
+            # durability point: fsync the journal at the checkpoint mark
+            # so the (checkpoint, journal-suffix) pair on disk is always
+            # a recoverable replay basis
+            self.journal.checkpoint_mark(self.kernel.tick_count)
+            self._journal_pump_counters()
         return self.checkpoint_dir
+
+    def shut(self) -> None:
+        super().shut()
+        if self.journal is not None:
+            self.journal.close()
+            self.journal = None
 
     def _queue_change(self, cname: str, pname: str, rows: np.ndarray) -> None:
         """Property-event sink: accumulate changed rows per (class, prop);

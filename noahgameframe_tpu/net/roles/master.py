@@ -64,6 +64,10 @@ class MasterRole(ServerRole):
         # per-type registries: type -> server_id -> _Registered
         self.registry: Dict[int, Dict[int, _Registered]] = {}
         self.http: Optional[HttpServer] = None
+        # chaos visibility: when a ChaosDirector is active the harness
+        # points this at director.status so /json shows the fault-plan
+        # seed + per-link budgets (replay can re-derive the chaos run)
+        self.chaos_status = None  # Optional[Callable[[], dict]]
         self.lease_suspect_seconds = lease_suspect_seconds
         self.lease_down_seconds = lease_down_seconds
         super().__init__(config, backend=backend)
@@ -232,10 +236,16 @@ class MasterRole(ServerRole):
                 d["last_seen_age_s"] = round(max(0.0, now - reg.last_seen), 3)
                 entries.append(d)
             out[key] = entries
-        return {
+        status = {
             "master": report_to_dict(self.report()),
             "servers": out,
         }
+        if self.chaos_status is not None:
+            try:
+                status["chaos"] = self.chaos_status()
+            except Exception:  # noqa: BLE001 — a dead probe must not kill /json
+                status["chaos"] = {"error": "chaos status unavailable"}
+        return status
 
     def _index_page(self, _path: str, _params: Dict[str, str]):
         """Dashboard at "/": serves the standalone monitor page

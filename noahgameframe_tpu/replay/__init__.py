@@ -1,0 +1,36 @@
+"""Deterministic flight recorder: per-tick input journal + replay.
+
+The world tick is a pure function of its inputs (SURVEY §3.3: injected
+commands + config in, diffs out), and PR 2/3 proved the property end to
+end — checkpoints restore bit-identical worlds, 120-tick soaks stay
+bit-identical.  This package turns that from a test-only property into
+an operational one:
+
+- :mod:`journal` — append-only, segmented, CRC-framed log of everything
+  that crosses the host→device boundary in a live GameRole (dispatched
+  net events, tick markers with on-device state digests, checkpoint
+  marks, chaos/config notes);
+- :mod:`replayer` — rebuild a GameRole offline from a
+  ``(checkpoint, journal-suffix)`` pair by re-feeding the journaled
+  events through the real handlers and the real jitted tick, asserting
+  every per-tick digest;
+- :mod:`bisect` — binary-search the first divergent tick between two
+  runs via their digest streams, then dump a field-level WorldState
+  diff at that tick.
+"""
+
+from .journal import (  # noqa: F401
+    JournalError,
+    JournalReader,
+    JournalWriter,
+    REC_CKPT,
+    REC_EVENT,
+    REC_META,
+    REC_NOTE,
+    REC_TICK,
+    SRC_SERVER,
+    SRC_WORLD,
+    read_ticks,
+)
+from .replayer import ReplayReport, make_offline_role, replay_journal  # noqa: F401
+from .bisect import bisect_divergence, field_diff  # noqa: F401

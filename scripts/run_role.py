@@ -69,6 +69,17 @@ def main() -> int:
     ap.add_argument("--resume", action="store_true",
                     help="game role: restore the latest checkpoint from "
                          "--checkpoint-dir before serving")
+    ap.add_argument("--journal", type=Path, default=None,
+                    help="game role: record every host->device input "
+                         "(commands, migrations, tick digests) to this "
+                         "flight-recorder directory")
+    ap.add_argument("--journal-segment-bytes", type=int, default=1 << 20,
+                    help="journal segment rotation threshold")
+    ap.add_argument("--replay", type=Path, default=None,
+                    help="game role: do not serve; rebuild device state "
+                         "offline from --checkpoint-dir + this journal, "
+                         "verify every per-tick digest, exit 0 iff "
+                         "bit-identical")
     args = ap.parse_args()
     if args.platform == "cpu":
         from noahgameframe_tpu.utils.platform import force_cpu
@@ -95,6 +106,16 @@ def main() -> int:
 
     atexit.register(_tidy_crash_file)
 
+    if args.replay is not None:
+        if args.role != "game":
+            print("--replay is a game-role mode", file=sys.stderr)
+            return 2
+        from noahgameframe_tpu.replay import replay_journal
+
+        report = replay_journal(args.replay, checkpoint=args.checkpoint_dir)
+        print(report.summary(), flush=True)
+        return 0 if report.ok else 1
+
     cls, stype, upstream_type = ROLE_CLASSES[args.role]
     rows = load_server_xml(args.server_xml)
     mine = [r for r in rows if r.server_type == stype and r.server_id == args.id]
@@ -112,6 +133,9 @@ def main() -> int:
         kwargs["checkpoint_dir"] = args.checkpoint_dir
         kwargs["checkpoint_seconds"] = args.checkpoint_seconds
         kwargs["resume"] = args.resume
+    if args.role == "game" and args.journal is not None:
+        kwargs["journal_dir"] = args.journal
+        kwargs["journal_segment_bytes"] = args.journal_segment_bytes
     role = cls(config, **kwargs)
     if args.role != "master" and args.http_port is not None:
         h = role.serve_metrics(args.http_port)

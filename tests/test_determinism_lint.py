@@ -1,0 +1,154 @@
+"""Determinism lint (ISSUE 4 satellite): the simulation layers must not
+read wall clocks or unseeded RNGs.
+
+Record/replay's whole contract is that device state is a pure function
+of (checkpoint, journaled inputs).  One stray ``time.time()`` or global
+``random.random()`` in a tick-path module silently breaks every replay,
+so this test walks the AST of ``kernel/``, ``ops/`` and ``game/`` and
+fails on:
+
+- ``time.time()`` calls, under any import alias (``import time as _t``,
+  ``from time import time``),
+- module-level ``random.*`` calls (the process-global RNG) — seeded
+  instance construction ``random.Random(seed)`` is fine,
+- ``np.random.*`` calls except ``np.random.default_rng(seed...)`` with
+  an explicit seed argument; references to ``np.random.Generator`` in
+  annotations are attribute loads, not calls, and pass.
+
+Methods on a seeded generator object (``rng.normal()``) are untouched:
+only *module*-rooted calls are nondeterministic by construction.
+"""
+
+import ast
+from pathlib import Path
+
+import pytest
+
+PKG = Path(__file__).resolve().parent.parent / "noahgameframe_tpu"
+SCANNED_DIRS = ("kernel", "ops", "game")
+
+
+def _files():
+    for d in SCANNED_DIRS:
+        yield from sorted((PKG / d).rglob("*.py"))
+
+
+def _dotted(node):
+    """Attribute/Name chain as a dotted string ('np.random.normal'),
+    or None for anything dynamic."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: Path):
+        self.path = path
+        self.offenses = []
+        # alias maps rebuilt per file from its own imports
+        self.time_aliases = set()  # modules: import time [as _t]
+        self.time_fn_aliases = set()  # names: from time import time [as t]
+        self.random_aliases = set()  # modules: import random [as _r]
+        self.numpy_aliases = set()  # modules: import numpy [as np]
+
+    def _flag(self, node, what):
+        self.offenses.append(
+            f"{self.path.relative_to(PKG.parent)}:{node.lineno}: {what}"
+        )
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = a.asname or a.name
+            if a.name == "time":
+                self.time_aliases.add(name)
+            elif a.name == "random":
+                self.random_aliases.add(name)
+            elif a.name == "numpy":
+                self.numpy_aliases.add(name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "time":
+            for a in node.names:
+                if a.name == "time":
+                    self.time_fn_aliases.add(a.asname or a.name)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        dotted = _dotted(node.func)
+        if dotted is not None:
+            self._check_call(node, dotted)
+        self.generic_visit(node)
+
+    def _check_call(self, node, dotted):
+        parts = dotted.split(".")
+        head, rest = parts[0], parts[1:]
+        if dotted in self.time_fn_aliases:
+            self._flag(node, f"wall clock read: {dotted}()")
+        elif head in self.time_aliases and rest == ["time"]:
+            self._flag(node, f"wall clock read: {dotted}()")
+        elif head in self.random_aliases and len(rest) == 1:
+            if rest[0] == "Random" and node.args:
+                return  # seeded instance
+            self._flag(node, f"process-global RNG: {dotted}()")
+        elif (head in self.numpy_aliases and len(rest) == 2
+              and rest[0] == "random"):
+            if rest[1] == "default_rng" and node.args:
+                return  # explicitly seeded generator
+            self._flag(node, f"unseeded numpy RNG: {dotted}()")
+
+
+def _lint(path: Path):
+    linter = _Linter(path)
+    linter.visit(ast.parse(path.read_text(), filename=str(path)))
+    return linter.offenses
+
+
+@pytest.mark.parametrize(
+    "path", list(_files()),
+    ids=lambda p: str(p.relative_to(PKG)),
+)
+def test_no_nondeterminism_in_tick_layers(path):
+    offenses = _lint(path)
+    assert not offenses, "\n".join(offenses)
+
+
+# --- the linter itself must catch what it claims to (meta-tests on
+# synthetic sources, so a refactor can't silently blunt the lint)
+def _lint_source(src: str, tmp_path) -> list:
+    f = PKG / "game" / "_lint_probe.py"  # relative_to(PKG.parent) must work
+    linter = _Linter(f)
+    linter.visit(ast.parse(src))
+    return linter.offenses
+
+
+@pytest.mark.parametrize("src", [
+    "import time\ntime.time()",
+    "import time as _time\n_time.time()",
+    "from time import time\ntime()",
+    "from time import time as now\nnow()",
+    "import random\nrandom.random()",
+    "import random as _r\n_r.randint(0, 9)",
+    "import random\nrandom.Random()",  # unseeded instance = global-ish
+    "import numpy as np\nnp.random.rand(3)",
+    "import numpy as np\nnp.random.default_rng()",  # seedless
+    "import numpy\nnumpy.random.normal()",
+])
+def test_linter_catches(src, tmp_path):
+    assert _lint_source(src, tmp_path), src
+
+
+@pytest.mark.parametrize("src", [
+    "import time\ntime.monotonic()",  # injectable-now pattern, not wall time
+    "import random\nr = random.Random(7)\nr.random()",
+    "import numpy as np\nrng = np.random.default_rng(5)\nrng.normal()",
+    "import numpy as np\ndef f(rng: np.random.Generator): ...",
+    "import numpy as np\nnp.arange(4)",
+])
+def test_linter_allows(src, tmp_path):
+    assert not _lint_source(src, tmp_path), src

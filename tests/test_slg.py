@@ -410,7 +410,7 @@ def test_restart_into_fresh_process_resolves_timers(world, tmp_path):
     agent = PlayerDataAgent(kv).bind(world.kernel)
     k = world.kernel
     b = world.slg_building
-    b._wall_base = 1_000_000.0  # process A started here
+    b.wall_base = 1_000_000.0  # process A started here
     b.upgrade_s = 30
     g = k.create_object("Player", {"Name": "F", "Account": "f"},
                         scene=1, group=0)
@@ -425,7 +425,7 @@ def test_restart_into_fresh_process_resolves_timers(world, tmp_path):
                                dt=1.0)).start()
     w2.scene.create_scene(1)
     b2 = w2.slg_building
-    b2._wall_base = 1_000_060.0  # 60 s of downtime
+    b2.wall_base = 1_000_060.0  # 60 s of downtime
     PlayerDataAgent(kv).bind(w2.kernel)
     g2 = w2.kernel.create_object("Player", {"Name": "F", "Account": "f"},
                                  scene=1, group=0)
