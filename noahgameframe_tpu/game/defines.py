@@ -23,7 +23,12 @@ class PropertyGroup(enum.IntEnum):
     EQUIP_AWARD = 4
     STATIC_BUFF = 5
     RUNTIME_BUFF = 6
-    ALL = 7  # row count, not a row
+    # the reference sums NINE contribution groups
+    # (NFCPropertyModule.cpp:193-240); these two complete the set.
+    # NEVER renumber 0-6: saved records and test fixtures index by row.
+    FIGHTING_HERO = 7  # the active hero lineup's stat fold (game/hero.py)
+    TALENT = 8
+    ALL = 9  # row count, not a row
 
 
 # the combat/consumable stat block every fighter carries — column order of

@@ -23,7 +23,8 @@ ROW (the reference's per-row GUID column exists only to find rows
 again); add_hero dedupes by ConfigID and a duplicate add raises the
 star instead (card-stacking — the reference appends duplicate rows);
 the stat fold sums EVERY positioned fight hero's config stats x level
-into the EQUIP_AWARD group.
+into the FIGHTING_HERO group (the reference's own NPG slot for the hero
+lineup contribution, distinct from equipment's EQUIP_AWARD).
 """
 
 from __future__ import annotations
@@ -231,7 +232,7 @@ class HeroModule(Module):
 
     def _refresh_fight_stats(self, guid: Guid) -> None:
         """Sum of every positioned hero's config stats x level into the
-        EQUIP_AWARD group (NFCHeroPropertyModule recompute shape)."""
+        FIGHTING_HERO group (NFCHeroPropertyModule recompute shape)."""
         k = self.kernel
         elems = k.elements
         totals = {n: 0 for n in STAT_NAMES}
@@ -244,7 +245,7 @@ class HeroModule(Module):
                 totals[n] += int(vals.get(n, 0) or 0) * level
         for n in STAT_NAMES:
             self.properties.set_group_value(
-                guid, n, PropertyGroup.EQUIP_AWARD, totals[n]
+                guid, n, PropertyGroup.FIGHTING_HERO, totals[n]
             )
 
     # ------------------------------------------------------- summoning

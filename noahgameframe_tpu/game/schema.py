@@ -27,8 +27,9 @@ def _stat_props():
 
 def _comm_property_record():
     """Per-group stat contributions; final stat = column sum over the group
-    rows (reference CommPropertyValue, Row=15 in the XML but only NPG_ALL=7
-    rows are ever used — we size it exactly)."""
+    rows (reference CommPropertyValue, Row=15 in the XML but only the
+    NPG_ALL=9 enum groups are ever used — we size it exactly from
+    PropertyGroup.ALL)."""
     return record(
         COMM_PROPERTY_RECORD,
         int(PropertyGroup.ALL),

@@ -11,9 +11,9 @@ TPU inversion: contributions live in the record bank `[C, NPG_ALL, S]`
 already, so the whole class's recompute is ONE sum over the group axis and
 ONE scatter into the property columns, fused into the tick.  The recompute
 phase runs unconditionally each tick (cheaper than tracking dirtiness at
-[C] granularity — it's a [C, 7, 29] int32 reduce, trivially MXU/VPU
-friendly); host mutators mirror the reference's imperative API for
-control-plane use.
+[C] granularity — it's a [C, 9, 29] int32 reduce over the reference's
+nine NPG_* contribution groups, trivially MXU/VPU friendly); host
+mutators mirror the reference's imperative API for control-plane use.
 """
 
 from __future__ import annotations

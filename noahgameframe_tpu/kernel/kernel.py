@@ -219,6 +219,11 @@ class Kernel(Module):
         self._composed: List[Phase] = []
         self._jit_step = None
         self._jit_run = None
+        # monotonically bumped whenever the compiled tick is dropped
+        # (invalidate / set_phases) so WRAPPING compilers — ShardedKernel
+        # keeps its own jitted variants of _trace_step — can notice and
+        # drop theirs too instead of dispatching a stale trace
+        self._trace_gen = 0
         self._class_event_subs: List[ClassEventFn] = []
         self._class_event_by_class: Dict[str, List[ClassEventFn]] = {}
         self._prop_event_subs: Dict[Tuple[str, str], List[PropertyEventFn]] = {}
@@ -283,6 +288,7 @@ class Kernel(Module):
         self._composed = sorted(phases, key=lambda p: p.order)
         self._jit_step = None
         self._jit_run = None
+        self._trace_gen += 1
 
     # -- the compiled tick --------------------------------------------------
 
@@ -456,6 +462,7 @@ class Kernel(Module):
         and the first new tick rebuilds them."""
         self._jit_step = None
         self._jit_run = None
+        self._trace_gen += 1
         if self._aux_init and self.state is not None and self.state.aux:
             kept = {
                 k: v for k, v in self.state.aux.items()

@@ -39,6 +39,7 @@ is BASELINE config 4).
 from __future__ import annotations
 
 import math
+import os
 from typing import Callable, NamedTuple, Tuple, TypeVar
 
 import jax
@@ -47,6 +48,32 @@ import jax.numpy as jnp
 from .aoi import cell_of
 
 A = TypeVar("A")
+
+# NF_BINNING picks the slot-assignment engine behind build_cell_table /
+# build_cell_table_pair (and the Verlet rebuild arm).  "sort" is the
+# original stable-argsort path; "count" is the sort-free counting path
+# (_cell_counts / _counting_ranks / _counting_slots) — bit-identical
+# tables, O(K*(N + n_cells)) streaming work instead of an O(N log N)
+# comparison network.  Trace-time like NF_RADIX: flip it, then retrace.
+ENV_BINNING = "NF_BINNING"
+BINNING_MODES = ("sort", "count")
+
+
+def binning_mode() -> str:
+    """The validated NF_BINNING mode; unset/empty means "sort".
+
+    Unknown values raise instead of falling through — a typo'd mode
+    silently running the default would invalidate any A/B it labeled.
+    This is the ONLY place the env var is read (pinned by
+    tests/test_binning.py's lint guard)."""
+    raw = os.environ.get(ENV_BINNING, "").strip()
+    if not raw:
+        return "sort"
+    if raw not in BINNING_MODES:
+        raise ValueError(
+            f"{ENV_BINNING}={raw!r}: expected one of {BINNING_MODES}"
+        )
+    return raw
 
 # 3x3 stencil in (dy, dx) order — must match ops.aoi._STENCIL so candidate
 # iteration order (and therefore argmax tie-breaking) is identical across
@@ -174,17 +201,14 @@ def _bits_for(n_cells: int) -> int:
     return max(1, int(n_cells).bit_length())
 
 
-def _sorted_segments(pos, active, cell_size: float, width: int,
-                     cell=None, n_cells: int | None = None):
-    """Shared build prefix: the ONE stable argsort by cell id plus
-    per-element segment ranks.  Returns (n_cells, order, skey, seg_start,
-    rank) — everything both table builders derive slots from.
+def _cell_keys(pos, active, cell_size: float, width: int,
+               cell=None, n_cells: int | None = None):
+    """Shared key pass for BOTH binning engines: per-row sort/bin key
+    (cell id, or n_cells for inactive rows).  Returns (n_cells, key).
 
     cell/n_cells: precomputed per-row cell ids over a caller-defined
     (possibly rectangular) grid — the spatial slab shards pass local
     slab-relative ids; default derives square-grid ids from pos."""
-    import os
-
     n = pos.shape[0]
     if n >= 1 << 24:
         # row ids (and other int-valued columns) ride in f32 payload
@@ -196,6 +220,19 @@ def _sorted_segments(pos, active, cell_size: float, width: int,
     elif n_cells is None:
         raise ValueError("precomputed cell ids need n_cells")
     key = jnp.where(active, cell, n_cells)
+    return n_cells, key
+
+
+def _sorted_segments(pos, active, cell_size: float, width: int,
+                     cell=None, n_cells: int | None = None):
+    """Shared build prefix of the SORT engine: the ONE stable argsort by
+    cell id plus per-element segment ranks.  Returns (n_cells, order,
+    skey, seg_start, rank) — everything both table builders derive slots
+    from."""
+    n = pos.shape[0]
+    n_cells, key = _cell_keys(
+        pos, active, cell_size, width, cell=cell, n_cells=n_cells
+    )
     radix = os.environ.get("NF_RADIX", "")
     if radix.isdigit() and int(radix) > 0:
         # NF_RADIX=<bits per pass>: 1 = binary partition passes,
@@ -212,6 +249,98 @@ def _sorted_segments(pos, active, cell_size: float, width: int,
     start_idx = jax.lax.cummax(jnp.where(seg_start, idx, 0))
     rank = idx - start_idx
     return n_cells, order, skey, seg_start, rank
+
+
+# --- the COUNT engine (NF_BINNING=count): histogram + bounded-rank
+# selection + scatter.  No sort or argsort anywhere (pinned by the AST
+# guard in tests/test_binning.py) — the super-linear comparison network
+# is gone from the build.
+
+
+def _cell_counts(key: jnp.ndarray, n_cells: int) -> jnp.ndarray:
+    """Histogram pass: [n_cells + 1] i32 occupancy per cell (last bin
+    counts inactive rows, key == n_cells) via ONE segment_sum — a single
+    streaming scatter-add over [N].  In the fixed-stride dense layout the
+    exclusive-cumsum offsets this histogram implies are simply
+    `cell * bucket`, so no scan materializes on the hot path; the
+    histogram itself feeds occupancy telemetry and the per-pass profile
+    (scripts/profile_passes.py times it in isolation)."""
+    return jax.ops.segment_sum(
+        jnp.ones_like(key), key, num_segments=n_cells + 1
+    )
+
+
+def _counting_ranks(key: jnp.ndarray, n_cells: int, kmax: int) -> jnp.ndarray:
+    """Deterministic within-cell rank in stable row-id order, WITHOUT a
+    sort: `kmax` rounds of scatter-min selection.  Round r finds each
+    cell's smallest not-yet-ranked row id (one `.at[key].min` scatter +
+    one gather), assigns it rank r, and retires it.  Rows never selected
+    (rank >= kmax, or inactive key == n_cells) keep rank == kmax.
+
+    This matches the stable-argsort rank EXACTLY wherever it matters:
+    both engines place the `kmax` smallest row ids of each cell (stable
+    sort ranks ascending row ids ascending) and dump the rest, so tables
+    — including overflow drops — are bit-identical.  Cost is
+    O(kmax * (N + n_cells)) streaming work with static shapes; at the 1M
+    benchmark geometry that is ~16 passes over ~4 MB for the victim
+    table versus the ~400-stage comparison network XLA's sort runs over
+    8 MB of (key, row) pairs."""
+    n = key.shape[0]
+    sentinel = jnp.int32(n)  # > any live row id; also the "retired" mark
+    remaining = jnp.where(key < n_cells, jnp.arange(n, dtype=jnp.int32),
+                          sentinel)
+    rank = jnp.full((n,), kmax, jnp.int32)
+
+    def one_round(r, state):
+        remaining, rank = state
+        win = (
+            jnp.full((n_cells + 1,), sentinel, jnp.int32)
+            .at[key]
+            .min(remaining)
+        )
+        # the `< sentinel` guard keeps retired rows of an EXHAUSTED cell
+        # (win == sentinel) from matching sentinel == sentinel
+        is_win = (remaining < sentinel) & (remaining == win[key])
+        rank = jnp.where(is_win, r, rank)
+        remaining = jnp.where(is_win, sentinel, remaining)
+        return remaining, rank
+
+    _, rank = jax.lax.fori_loop(0, kmax, one_round, (remaining, rank))
+    return rank
+
+
+def _counting_slots(key: jnp.ndarray, n_cells: int, bucket: int) -> jnp.ndarray:
+    """Per-row flat payload slot from the counting ranks: placed rows get
+    `cell * bucket + rank` (the histogram's trivially-dense exclusive
+    offsets), everything else the dump slot.  Drop-in replacement for the
+    sort path's un-sorted `_finish_table` slot assignment."""
+    rank = _counting_ranks(key, n_cells, bucket)
+    dump = n_cells * bucket
+    return jnp.where(rank < bucket, key * bucket + rank, dump).astype(jnp.int32)
+
+
+def _build_pair_counting(
+    features, active, sub_mask, sub_features,
+    key, n_cells: int, cell_size: float, width: int,
+    bucket: int, sub_bucket: int, height: int = -1,
+) -> Tuple[CellTable, CellTable]:
+    """COUNT-engine pair build from a precomputed key: full and subset
+    tables each run their own bounded-rank selection + payload scatter.
+    The subset re-ranks over `sub_key` so a sub member's rank is its
+    ordinal among SUB members of its cell — same contract as the sort
+    path's segmented cumsum (a row overflowing the full table can still
+    hold a valid subset slot)."""
+    slot_of = _counting_slots(key, n_cells, bucket)
+    full = table_from_slots(
+        features, active, slot_of, n_cells, cell_size, width, bucket, height
+    )
+    sub_key = jnp.where(sub_mask, key, n_cells)
+    sub_slots = _counting_slots(sub_key, n_cells, sub_bucket)
+    sub = table_from_slots(
+        sub_features, sub_mask, sub_slots, n_cells, cell_size, width,
+        sub_bucket, height,
+    )
+    return full, sub
 
 
 def table_from_slots(
@@ -271,15 +400,27 @@ def build_cell_table(
     """Bin `active` entities into the uniform grid, carrying `features`.
 
     pos: [N, >=2] positions; active: [N] bool; features: [N, F] float32.
-    One argsort + one permutation-gather + one scatter; all slot indices
-    are unique so the scatter is deterministic.
+    Slot assignment dispatches on NF_BINNING (bit-identical either way):
+    sort = one argsort + permutation-gather + scatter; count = bounded
+    scatter-min ranks, no sort.  All slot indices are unique so the
+    payload scatter is deterministic.
     """
-    n_cells, order, skey, _seg_start, rank = _sorted_segments(
-        pos, active, cell_size, width
-    )
-    return _finish_table(
-        features, active, n_cells, order, skey, rank, cell_size, width, bucket
-    )
+    mode = binning_mode()
+    if mode == "count":
+        n_cells, key = _cell_keys(pos, active, cell_size, width)
+        slot_of = _counting_slots(key, n_cells, bucket)
+        return table_from_slots(
+            features, active, slot_of, n_cells, cell_size, width, bucket
+        )
+    if mode == "sort":
+        n_cells, order, skey, _seg_start, rank = _sorted_segments(
+            pos, active, cell_size, width
+        )
+        return _finish_table(
+            features, active, n_cells, order, skey, rank, cell_size, width,
+            bucket,
+        )
+    raise ValueError(f"unhandled binning mode {mode!r}")  # pragma: no cover
 
 
 def build_cell_table_pair(
@@ -295,7 +436,12 @@ def build_cell_table_pair(
     cell: jnp.ndarray | None = None,
     height: int = -1,
 ) -> Tuple[CellTable, CellTable]:
-    """Build the full table AND a subset table from ONE argsort.
+    """Build the full table AND a subset table from ONE key pass.
+
+    Dispatches on NF_BINNING: the sort engine derives both tables from a
+    single stable argsort; the count engine runs bounded scatter-min
+    selection per table (no sort at all).  Both produce bit-identical
+    tables — including which rows overflow to the dump slot.
 
     `sub_mask` must be a subset of `active` (combat: attackers among all
     alive entities).  Placement is bit-identical to two independent
@@ -307,6 +453,18 @@ def build_cell_table_pair(
     cell/height: precomputed cell ids over a rectangular [height, width]
     grid (spatial slab shards); default square grid derived from pos."""
     n_rows = height if height > 0 else width
+    mode = binning_mode()
+    if mode == "count":
+        n_cells, key = _cell_keys(
+            pos, active, cell_size, width, cell=cell,
+            n_cells=(n_rows * width if cell is not None else None),
+        )
+        return _build_pair_counting(
+            features, active, sub_mask, sub_features, key, n_cells,
+            cell_size, width, bucket, sub_bucket, height,
+        )
+    if mode != "sort":
+        raise ValueError(f"unhandled binning mode {mode!r}")  # pragma: no cover
     n_cells, order, skey, seg_start, rank = _sorted_segments(
         pos, active, cell_size, width, cell=cell,
         n_cells=(n_rows * width if cell is not None else None),

@@ -52,7 +52,15 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .stencil import CellTable, _finish_table, _sorted_segments, table_from_slots
+from .stencil import (
+    CellTable,
+    _cell_keys,
+    _counting_slots,
+    _finish_table,
+    _sorted_segments,
+    binning_mode,
+    table_from_slots,
+)
 
 ENV_SKIN = "NF_VERLET_SKIN"
 
@@ -63,8 +71,21 @@ class VerletCache(NamedTuple):
 
     anchor_pos:    [N, 2] f32 — positions at the last rebuild.
     anchor_active: [N] bool   — active mask at the last rebuild.
-    order:         [N] i32    — the stable sort by anchor cell id.
-    skey:          [N] i32    — sorted cell keys (inactive == n_cells).
+    order:         [N] i32    — the stable sort by anchor cell id
+                                (NF_BINNING=sort engine; the count engine
+                                has no sorted order and stores arange —
+                                carried but unused).
+    skey:          [N] i32    — engine-dependent: the SORTED cell keys
+                                under the sort engine, the PER-ROW anchor
+                                cell keys under the count engine (both
+                                use n_cells for inactive).  Either way it
+                                is exactly what sub_table() needs to
+                                re-rank a fresh subset on a reuse tick,
+                                and it is meaningless across engines — a
+                                cache built under one NF_BINNING value
+                                must be dropped before running the other
+                                (SpatialWorld.load() enforces this for
+                                snapshots).
     slot_of:       [N] i32    — full-table slot per row for the bucket the
                                 cache was built with (geometry-baked: any
                                 bucket/width change must drop the cache).
@@ -184,14 +205,26 @@ def refresh(
     trig = need_rebuild(cache, pos, active, skin, axis_name=axis_name)
     n = pos.shape[0]
     dump = n_cells * bucket
+    mode = binning_mode()  # trace-time, like the NF_RADIX read below it
 
     def rebuild(_):
-        _nc, order, skey, _seg_start, rank = _sorted_segments(
-            pos, active, cell_size, width, cell=cell, n_cells=n_cells
-        )
-        placed = (rank < bucket) & (skey < n_cells)
-        flat_sorted = jnp.where(placed, skey * bucket + rank, dump)
-        slot_of = jnp.full((n,), dump, jnp.int32).at[order].set(flat_sorted)
+        if mode == "count":
+            # sort-free anchor: bounded scatter-min slots; `skey` caches
+            # the PER-ROW anchor keys (what sub_table re-ranks against),
+            # `order` degenerates to identity (see VerletCache docstring)
+            _nc, key = _cell_keys(
+                pos, active, cell_size, width, cell=cell, n_cells=n_cells
+            )
+            order = jnp.arange(n, dtype=jnp.int32)
+            skey = key
+            slot_of = _counting_slots(key, n_cells, bucket)
+        else:
+            _nc, order, skey, _seg_start, rank = _sorted_segments(
+                pos, active, cell_size, width, cell=cell, n_cells=n_cells
+            )
+            placed = (rank < bucket) & (skey < n_cells)
+            flat_sorted = jnp.where(placed, skey * bucket + rank, dump)
+            slot_of = jnp.full((n,), dump, jnp.int32).at[order].set(flat_sorted)
         return VerletCache(
             anchor_pos=pos[:, :2].astype(jnp.float32),
             anchor_active=active,
@@ -243,8 +276,17 @@ def sub_table(
     cached order: the subset CHANGES every tick, so its per-cell ranks are
     recomputed — but via the same segmented exclusive cumsum
     build_cell_table_pair uses, a streaming pass over the cached sorted
-    order instead of a second argsort.  Bit-identical to the pair builder's
-    sub table for any sub_mask subset of the anchor active set."""
+    order instead of a second argsort.  Under NF_BINNING=count the cached
+    `skey` holds per-row anchor keys instead, and the subset re-runs the
+    bounded scatter-min selection over them.  Bit-identical to the pair
+    builder's sub table for any sub_mask subset of the anchor active set."""
+    if binning_mode() == "count":
+        sub_key = jnp.where(sub_mask, cache.skey, n_cells)
+        sub_slots = _counting_slots(sub_key, n_cells, sub_bucket)
+        return table_from_slots(
+            sub_features, sub_mask, sub_slots, n_cells, cell_size, width,
+            sub_bucket, height,
+        )
     order, skey = cache.order, cache.skey
     seg_start = jnp.concatenate(
         [jnp.ones((1,), bool), skey[1:] != skey[:-1]]

@@ -92,6 +92,22 @@ class ShardedKernel:
             )
         self._jit_step = None
         self._jit_run = None
+        self._seen_trace_gen = getattr(kernel, "_trace_gen", 0)
+
+    def _sync_generation(self) -> None:
+        """Drop the sharded traces when the wrapped kernel invalidated.
+
+        Kernel.invalidate() (overflow auto-resize, set_phases, digest
+        enable) clears only the kernel's OWN jits; without this check the
+        sharded wrapper would keep dispatching its stale trace — e.g.
+        CombatModule's bucket doubling would never take effect under
+        ShardedKernel and overflow drops would repeat forever."""
+        gen = getattr(self.kernel, "_trace_gen", 0)
+        if gen != self._seen_trace_gen:
+            self._jit_step = None
+            self._jit_step1 = None
+            self._jit_run = None
+            self._seen_trace_gen = gen
 
     # -- placement -----------------------------------------------------------
 
@@ -124,6 +140,7 @@ class ShardedKernel:
         from ..kernel.kernel import DeviceEvent, TickOutputs
 
         k = self.kernel
+        self._sync_generation()
         k._ensure_aux()
         step = self._compile()
         k.state, raw = step(k.state)
@@ -175,6 +192,7 @@ class ShardedKernel:
         device-resident (no readbacks), and compile cost is one step's —
         what bench.py's ladder uses so compile doesn't dominate."""
         key = int(n)
+        self._sync_generation()
         self.kernel._ensure_aux()
         if not fused:
             step = self._compile_headless()

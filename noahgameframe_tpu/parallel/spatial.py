@@ -52,7 +52,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..game.combat import combat_fold_closure
-from ..ops.stencil import build_cell_table_pair, pull
+from ..ops.stencil import binning_mode, build_cell_table_pair, pull
 from ..ops.verlet import VerletCache, full_table, refresh, sub_table
 from .mesh import SHARD_AXIS, make_mesh
 
@@ -379,6 +379,13 @@ class SpatialWorld:
         self.stats_last = np.zeros((geom.n_shards, 6), np.int32)
         self.overflow_budget = 1e-4  # alert threshold, as CombatModule
         self.overflow_alerts = 0
+        # crowding response, ported from CombatModule._on_overflow: when
+        # cell-bucket drops breach the budget, double both buckets
+        # (bounded) and retrace — silent drops stop instead of repeating
+        # every tick (r05_sharded_4m saw grid_overflow_max=374/tick).
+        self.auto_resize = True
+        self.max_bucket_boost = 8
+        self._bucket_boost = 1
         self._step = None
 
     # -- placement --------------------------------------------------------
@@ -492,6 +499,44 @@ class SpatialWorld:
                     100 * self.overflow_budget,
                     self.stats_last.sum(axis=0).tolist(),
                 )
+            # cell-bucket drops specifically (columns 4:6) respond to a
+            # bucket resize; migration misses do not
+            drops = int(self.stats_last[:, 4:].sum())
+            if (
+                self.auto_resize
+                and drops / pop > self.overflow_budget
+                and self._bucket_boost < self.max_bucket_boost
+            ):
+                self._resize_buckets(drops, pop)
+
+    def _resize_buckets(self, drops: int, pop: int) -> None:
+        """Double both cell buckets and retrace — the SpatialGeom twin of
+        CombatModule._on_overflow.  The carried Verlet cache bakes the
+        old bucket into its slot assignment, so its leaves are zeroed
+        (all-False anchor => next tick rebuilds); the lifetime counters
+        in cstat survive."""
+        self._bucket_boost *= 2
+        g = self.geom
+        self.geom = g._replace(bucket=g.bucket * 2, att_bucket=g.att_bucket * 2)
+        self._step = None
+        st = self.state
+        self.state = st._replace(
+            vc_pos=jnp.zeros_like(st.vc_pos),
+            vc_active=jnp.zeros_like(st.vc_active),
+            vc_order=jnp.zeros_like(st.vc_order),
+            vc_skey=jnp.zeros_like(st.vc_skey),
+            vc_slot=jnp.zeros_like(st.vc_slot),
+        )
+        import logging
+
+        logging.getLogger("nf.spatial").warning(
+            "cell-bucket overflow: %d drops over %d rows breached budget "
+            "%.4f%%; buckets doubled to %d/%d (boost x%d of max x%d), "
+            "step retraced",
+            drops, pop, 100 * self.overflow_budget,
+            self.geom.bucket, self.geom.att_bucket,
+            self._bucket_boost, self.max_bucket_boost,
+        )
 
     # -- Verlet cache visibility ------------------------------------------
     @property
@@ -526,7 +571,7 @@ class SpatialWorld:
         st = jax.tree.map(np.asarray, self.state)
         np.savez_compressed(
             path, tick=self.tick_count, bank=self.bank_size,
-            **st._asdict(),
+            binning=binning_mode(), **st._asdict(),
         )
 
     def load(self, path: str) -> None:
@@ -545,9 +590,23 @@ class SpatialWorld:
                 "vc_slot": np.zeros((cap,), np.int32),
                 "cstat": np.zeros((self.geom.n_shards, 3), np.int32),
             }
+            # vc_order/vc_skey are NF_BINNING-engine-specific (sorted
+            # keys vs per-row anchor keys — VerletCache docstring); a
+            # snapshot resumed under the other engine must drop the
+            # cache or reuse-tick sub tables silently corrupt.  Old
+            # snapshots carry no marker and were written by the sort
+            # engine.
+            stored = str(z["binning"]) if "binning" in z.files else "sort"
+            drop_cache = stored != binning_mode()
+
+            def pick(f):
+                if f in z.files and not (drop_cache and f.startswith("vc_")):
+                    return z[f]
+                return fresh[f]
+
             sh = NamedSharding(self.mesh, P(self.axis))
             self.state = SpatialState(
-                *[jax.device_put(z[f] if f in z.files else fresh[f], sh)
+                *[jax.device_put(pick(f), sh)
                   for f in SpatialState._fields]
             )
 

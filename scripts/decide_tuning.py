@@ -83,6 +83,19 @@ def main() -> None:
     if best_skin is not None:
         tuning["NF_VERLET_SKIN"] = best_skin
 
+    # Counting-sort binning (NF_BINNING, ops/stencil.py): the r07 A/B
+    # pins its OWN baseline (env NF_BINNING=sort in the harvest queue,
+    # immune to this file's previous output) — compare count against
+    # that same-round capture when it exists, else the round baseline.
+    count_base = tick_ms("r07_tpu_1m.json")
+    if count_base is None:
+        count_base = base
+    count_ms = tick_ms("r07_tpu_1m_count.json")
+    detail["binning_sort_tick_ms"] = count_base
+    detail["binning_count_tick_ms"] = count_ms
+    if count_ms is not None and count_ms < count_base * MARGIN:
+        tuning["NF_BINNING"] = "count"
+
     out = {"env": tuning, "detail": detail}
     with open(os.path.join(RUNS, "tuning.json"), "w") as f:
         json.dump(out, f, indent=1)

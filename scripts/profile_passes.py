@@ -50,6 +50,9 @@ def main() -> None:
     from noahgameframe_tpu.ops.aoi import cell_of
     from noahgameframe_tpu.ops.stencil import (
         _bits_for,
+        _build_pair_counting,
+        _cell_counts,
+        _counting_ranks,
         _radix_argsort,
         build_cell_table_pair,
         pull,
@@ -163,6 +166,31 @@ def main() -> None:
     timed("build_pair_tables", build, pos, alive, vic_feats, attacking, att_feats)
     vic_table, att_table = jax.block_until_ready(
         build(pos, alive, vic_feats, attacking, att_feats)
+    )
+
+    # -- counting-sort binning passes (NF_BINNING=count, ops/stencil.py):
+    # histogram, the K-round scatter-min rank selection, and the whole
+    # sort-free pair build — timed directly against argsort_* and
+    # build_pair_tables above so the A/B decomposes per pass -------------
+    timed(
+        "count_histogram",
+        jax.jit(lambda kk: _cell_counts(kk, n_cells)),
+        key,
+    )
+    timed(
+        "count_rank_rounds",  # bucket rounds of scatter-min over [N]
+        jax.jit(lambda kk: _counting_ranks(kk, n_cells, bucket)),
+        key,
+    )
+    timed(
+        "count_build_pair",  # full sort-free twin of build_pair_tables
+        jax.jit(
+            lambda kk, al, vf, am, af: _build_pair_counting(
+                vf, al, am, af, kk, n_cells, cell_size, width, bucket,
+                att_bucket,
+            )
+        ),
+        key, alive, vic_feats, attacking, att_feats,
     )
 
     # -- Verlet cache passes (ops/verlet.py): what a rebuild tick, a reuse

@@ -13,6 +13,31 @@ cd "$(dirname "$0")/.."
 STAMPS=/tmp/tpu_harvest_stamps
 mkdir -p "$STAMPS" bench_runs
 
+# Cooperative handoff with bench.py (the driver's end-of-round run):
+# bench raises YIELD_FLAG (its pid inside) when it wants the chip; we
+# finish the item in flight, then WAIT here instead of being SIGTERMed
+# mid-capture.  While an item runs we hold HOLDER_FLAG (our pid) so the
+# bench knows to wait for it.  Stale flags (dead pids) are cleared on
+# both sides so a crashed peer never wedges the protocol.
+YIELD_FLAG=/tmp/nf_tpu_yield
+HOLDER_FLAG=/tmp/nf_tpu_holder
+trap 'rm -f "$HOLDER_FLAG"' EXIT
+
+wait_for_clearance() {
+  while [ -e "$YIELD_FLAG" ]; do
+    local yp
+    yp=$(cat "$YIELD_FLAG" 2>/dev/null)
+    if [ -n "$yp" ] && ! kill -0 "$yp" 2>/dev/null; then
+      # flag owner died without cleanup — a stale flag must not starve
+      # the harvest forever
+      rm -f "$YIELD_FLAG"
+      break
+    fi
+    echo "[$(date -u +%H:%M:%S)] yielding TPU to pid ${yp:-?}"
+    sleep 15
+  done
+}
+
 probe() {
   timeout 110 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'; import jax.numpy as jnp; print(jax.jit(lambda x:x+1)(jnp.zeros(4))[0])" >/dev/null 2>&1
 }
@@ -22,9 +47,12 @@ probe() {
 run_item() {
   local name=$1 tmo=$2; shift 2
   [ -e "$STAMPS/$name" ] && return 0
+  wait_for_clearance
+  echo "$$" > "$HOLDER_FLAG"
   echo "[$(date -u +%H:%M:%S)] START $name"
   timeout "$tmo" "$@" > "/tmp/harvest_$name.out" 2>&1
   local rc=$?
+  rm -f "$HOLDER_FLAG"
   # success = exit 0 + a JSON/marker line that is NOT an error payload
   # (bench.py catches exceptions and emits {"metric":..., "error":...}
   # with exit 0 — stamping that would archive a dead-tunnel artifact)
@@ -44,12 +72,29 @@ save_json() { # save_json <name> <dest>  — extract last JSON line
 }
 
 while :; do
+  wait_for_clearance
   if ! probe; then
     echo "[$(date -u +%H:%M:%S)] tunnel down"
     sleep 230
     continue
   fi
   echo "[$(date -u +%H:%M:%S)] tunnel UP — harvesting"
+
+  # 0. HEAD OF QUEUE: counting-sort binning A/B (NF_BINNING, ISSUE 5) at
+  #    100k and 1M — the first tunnel return-window measures the new
+  #    builder against the argsort path.  Baselines pin NF_BINNING=sort
+  #    explicitly: bench.py applies bench_runs/tuning.json via setdefault
+  #    on on-chip runs, so if a previous decide_tuning pass ever elected
+  #    "count", an unpinned baseline would silently run count too and
+  #    the A/B would compare count against itself.
+  run_item b100k_r07 900 env NF_BINNING=sort python -u bench.py --entities 100000 --ticks 90 --platform tpu \
+    && save_json b100k_r07 bench_runs/r07_tpu_100k.json
+  run_item b100k_count 900 env NF_BINNING=count python -u bench.py --entities 100000 --ticks 90 --platform tpu \
+    && save_json b100k_count bench_runs/r07_tpu_100k_count.json
+  run_item b1m_r07 1800 env NF_BINNING=sort python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
+    && save_json b1m_r07 bench_runs/r07_tpu_1m.json
+  run_item b1m_count 1800 env NF_BINNING=count python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
+    && save_json b1m_count bench_runs/r07_tpu_1m_count.json
 
   # 1. honest 100k re-capture (new reconcile-free windowed sampler)
   run_item b100k 900 python -u bench.py --entities 100000 --ticks 90 --platform tpu \
@@ -135,7 +180,7 @@ while :; do
     && save_json b100k_walk bench_runs/r05_tpu_100k_nocombat.json
 
   n_done=$(ls "$STAMPS" | wc -l)
-  if [ "$n_done" -ge 18 ]; then
+  if [ "$n_done" -ge 22 ]; then
     echo "[$(date -u +%H:%M:%S)] queue drained — exiting"
     exit 0
   fi

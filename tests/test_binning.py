@@ -1,0 +1,438 @@
+"""NF_BINNING=count parity + guard rails (the counting-sort tentpole).
+
+The contract: the count engine (histogram + bounded scatter-min ranks +
+scatter, ops/stencil.py) is BIT-IDENTICAL to the stable-argsort engine —
+payload, slot_of and dropped, including WHICH rows overflow to the dump
+slot — across the full matrix NF_BINNING x NF_RADIX x Verlet skin, over
+degenerate occupancies, and through a whole fused 24/120-tick world run
+(state_digest equality).  Plus two lint-style guards: the counting build
+path contains no sort/argsort call, and nothing outside
+stencil.binning_mode() reads the env var."""
+
+import ast
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from noahgameframe_tpu.ops import stencil
+from noahgameframe_tpu.ops.stencil import (
+    BINNING_MODES,
+    binning_mode,
+    build_cell_table,
+    build_cell_table_pair,
+)
+from noahgameframe_tpu.ops.verlet import (
+    full_table,
+    init_cache,
+    refresh,
+    sub_table,
+)
+
+PKG = Path(__file__).resolve().parent.parent / "noahgameframe_tpu"
+
+
+# --------------------------------------------------------------- fixtures
+
+def _case(seed, n, width, cell, p_active=0.85, p_sub=0.3):
+    rng = np.random.default_rng(seed)
+    pos = jnp.asarray(rng.uniform(0, width * cell, (n, 2)).astype(np.float32))
+    active = jnp.asarray(rng.random(n) < p_active)
+    sub = jnp.asarray(rng.random(n) < p_sub) & active
+    feats = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+    sfeats = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
+    # pair-builder positional order: (pos, active, features, sub_mask,
+    # sub_features) — splat-ready
+    return pos, active, feats, sub, sfeats
+
+
+def _set_mode(monkeypatch, mode, radix=0):
+    if mode == "sort":
+        monkeypatch.delenv("NF_BINNING", raising=False)
+    else:
+        monkeypatch.setenv("NF_BINNING", mode)
+    if radix:
+        monkeypatch.setenv("NF_RADIX", str(radix))
+    else:
+        monkeypatch.delenv("NF_RADIX", raising=False)
+
+
+def _np_tables(tables):
+    out = []
+    for t in tables:
+        out.append((np.asarray(t.payload), np.asarray(t.slot_of),
+                    int(t.dropped)))
+    return out
+
+
+def _assert_tables_equal(a, b, label=""):
+    for (pa, sa, da), (pb, sb, db) in zip(_np_tables(a), _np_tables(b)):
+        np.testing.assert_array_equal(pa, pb, err_msg=f"{label} payload")
+        np.testing.assert_array_equal(sa, sb, err_msg=f"{label} slot_of")
+        assert da == db, f"{label} dropped {da} != {db}"
+
+
+# ------------------------------------------------- pair-builder bit parity
+
+@pytest.mark.parametrize("radix", [0, 1, 2])
+@pytest.mark.parametrize("bucket,sub_bucket", [(16, 8), (4, 2), (1, 1)])
+def test_pair_matrix_bit_identical(monkeypatch, radix, bucket, sub_bucket):
+    """build_cell_table_pair: count == sort(+radix variants) bit-for-bit,
+    including the forced-overflow (1, 1) geometry where MOST rows drop —
+    both engines must keep the same (smallest-row-id) winners."""
+    case = _case(7, 311, 8, 4.0)
+    _set_mode(monkeypatch, "sort", radix)
+    ref = build_cell_table_pair(*case, 4.0, 8, bucket, sub_bucket)
+    _set_mode(monkeypatch, "count")
+    got = build_cell_table_pair(*case, 4.0, 8, bucket, sub_bucket)
+    _assert_tables_equal(ref, got, f"radix={radix} bucket={bucket}")
+
+
+def test_single_table_bit_identical(monkeypatch):
+    pos, active, feats, _sub, _sf = _case(3, 257, 8, 4.0)
+    _set_mode(monkeypatch, "sort")
+    ref = build_cell_table(pos, active, feats, 4.0, 8, 12)
+    _set_mode(monkeypatch, "count")
+    got = build_cell_table(pos, active, feats, 4.0, 8, 12)
+    _assert_tables_equal([ref], [got], "single")
+
+
+@pytest.mark.parametrize("name,case_kw", [
+    ("all_inactive", dict(p_active=0.0)),
+    ("all_active", dict(p_active=1.0, p_sub=1.0)),
+    ("sub_empty", dict(p_sub=0.0)),
+])
+def test_degenerate_masks_bit_identical(monkeypatch, name, case_kw):
+    case = _case(11, 200, 8, 4.0, **case_kw)
+    _set_mode(monkeypatch, "sort")
+    ref = build_cell_table_pair(*case, 4.0, 8, 8, 4)
+    _set_mode(monkeypatch, "count")
+    got = build_cell_table_pair(*case, 4.0, 8, 8, 4)
+    _assert_tables_equal(ref, got, name)
+
+
+def test_all_one_cell_and_one_overfull_cell(monkeypatch):
+    """Worst-case occupancy skew: every entity in a single cell (every
+    other cell empty), then one packed cell among a uniform field.  The
+    scatter-min rounds must rank exactly the bucket smallest row ids."""
+    n, width, cell = 300, 8, 4.0
+    rng = np.random.default_rng(13)
+    active = jnp.ones(n, bool)
+    sub = jnp.asarray(rng.random(n) < 0.4)
+    feats = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
+    sfeats = feats[:, :1]
+
+    one_cell = jnp.broadcast_to(
+        jnp.float32([cell * 2.5, cell * 2.5]), (n, 2)
+    )
+    packed = jnp.asarray(
+        rng.uniform(0, width * cell, (n, 2)).astype(np.float32)
+    ).at[: n // 2].set(jnp.float32([cell * 5.5, cell * 5.5]))
+
+    for label, pos in (("one_cell", one_cell), ("packed", packed)):
+        _set_mode(monkeypatch, "sort")
+        ref = build_cell_table_pair(pos, active, feats, sub, sfeats,
+                                    cell, width, 8, 4)
+        assert int(ref[0].dropped) > 0, f"{label}: no overflow exercised"
+        _set_mode(monkeypatch, "count")
+        got = build_cell_table_pair(pos, active, feats, sub, sfeats,
+                                    cell, width, 8, 4)
+        _assert_tables_equal(ref, got, label)
+
+
+def test_rect_grid_precomputed_cells_bit_identical(monkeypatch):
+    """The spatial slab path: precomputed cell ids over a rectangular
+    [height, width] grid (cell=..., height=...) through both engines."""
+    h, w, cell = 4, 8, 4.0
+    n = 220
+    rng = np.random.default_rng(17)
+    pos = jnp.asarray(
+        np.c_[rng.uniform(0, w * cell, n), rng.uniform(0, h * cell, n)]
+        .astype(np.float32)
+    )
+    active = jnp.asarray(rng.random(n) < 0.9)
+    sub = jnp.asarray(rng.random(n) < 0.3) & active
+    feats = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
+    sfeats = feats
+    cx = jnp.clip((pos[:, 0] / cell).astype(jnp.int32), 0, w - 1)
+    cy = jnp.clip((pos[:, 1] / cell).astype(jnp.int32), 0, h - 1)
+    cid = cy * w + cx
+    _set_mode(monkeypatch, "sort")
+    ref = build_cell_table_pair(pos, active, feats, sub, sfeats,
+                                cell, w, 6, 4, cell=cid, height=h)
+    _set_mode(monkeypatch, "count")
+    got = build_cell_table_pair(pos, active, feats, sub, sfeats,
+                                cell, w, 6, 4, cell=cid, height=h)
+    _assert_tables_equal(ref, got, "rect")
+
+
+def test_fuzz_overflow_sweep(monkeypatch):
+    """Random densities x tiny buckets: whatever drops, BOTH engines drop
+    the same rows (slot_of equality is the strong form of that claim)."""
+    for seed in range(6):
+        rng = np.random.default_rng(100 + seed)
+        n = int(rng.integers(16, 400))
+        width = int(rng.integers(2, 10))
+        bucket = int(rng.integers(1, 6))
+        sub_bucket = int(rng.integers(1, bucket + 1))
+        case = _case(seed, n, width, 4.0,
+                     p_active=float(rng.uniform(0.1, 1.0)),
+                     p_sub=float(rng.uniform(0.0, 1.0)))
+        _set_mode(monkeypatch, "sort")
+        ref = build_cell_table_pair(*case, 4.0, width, bucket, sub_bucket)
+        _set_mode(monkeypatch, "count")
+        got = build_cell_table_pair(*case, 4.0, width, bucket, sub_bucket)
+        _assert_tables_equal(ref, got, f"fuzz seed={seed}")
+
+
+# --------------------------------------------------- verlet cache parity
+
+@pytest.mark.parametrize("skin", [0.0, 2.0])
+def test_verlet_tables_cross_engine(monkeypatch, skin):
+    """A cache anchored under count reproduces the sort-engine pair
+    builder through full_table/sub_table — rebuild arm AND the reuse
+    replay both land on identical tables."""
+    n, width, cell = 257, 8, 4.0
+    pos, active, feats, sub, sfeats = _case(5, n, width, cell)
+    _set_mode(monkeypatch, "sort")
+    ref = build_cell_table_pair(pos, active, feats, sub, sfeats,
+                                cell, width, 12, 8)
+    _set_mode(monkeypatch, "count")
+    cache, rebuilt = refresh(
+        init_cache(n), pos, active, cell, width, 12, skin
+    )
+    assert int(rebuilt) == 1
+    got_full = full_table(cache, feats, active, width * width, cell,
+                          width, 12)
+    got_sub = sub_table(cache, sub, sfeats, width * width, cell, width, 8)
+    _assert_tables_equal(ref, (got_full, got_sub), f"verlet skin={skin}")
+
+
+def test_verlet_reuse_tick_count_engine(monkeypatch):
+    """Reuse branch under count: after sub-skin drift, sub_table with a
+    fresh mask equals the pair builder run against the ANCHOR binning."""
+    _set_mode(monkeypatch, "count")
+    rng = np.random.default_rng(9)
+    n, width, cell = 181, 8, 4.0
+    pos0 = jnp.asarray(
+        rng.uniform(1, width * cell - 1, (n, 2)).astype(np.float32)
+    )
+    active = jnp.ones(n, bool)
+    cache, _ = refresh(init_cache(n), pos0, active, cell, width, 12, 2.0)
+    pos1 = pos0 + jnp.asarray(
+        rng.uniform(-0.4, 0.4, (n, 2)).astype(np.float32)
+    )
+    cache, rebuilt = refresh(cache, pos1, active, cell, width, 12, 2.0)
+    assert int(rebuilt) == 0
+    sub = jnp.asarray(rng.random(n) < 0.25)
+    sfeats = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
+    got = sub_table(cache, sub, sfeats, width * width, cell, width, 8)
+    _, ref = build_cell_table_pair(
+        pos0, active, jnp.zeros((n, 1), jnp.float32), sub, sfeats,
+        cell, width, 12, 8,
+    )
+    np.testing.assert_array_equal(np.asarray(ref.payload),
+                                  np.asarray(got.payload))
+
+
+# ------------------------------------------------ fused world-run digests
+
+def _digest_world(skin, ticks):
+    from noahgameframe_tpu.game.world import GameWorld, WorldConfig
+
+    w = GameWorld(WorldConfig(
+        npc_capacity=2048, extent=96.0, seed=11, middleware=False,
+        aoi_bucket=64, verlet_skin=skin,
+    ))
+    w.start()
+    w.scene.create_scene(1, width=96.0)
+    w.seed_npcs(2000)
+    k = w.kernel
+    k.enable_digest()
+    k.run_device(ticks)
+    k.tick()
+    return k.last_counters["state_digest"] & 0xFFFFFFFF
+
+
+@pytest.mark.parametrize("skin", [None, 2.0])
+def test_fused_run_digest_parity_24(monkeypatch, skin):
+    """24 fused device ticks (with and without the Verlet cache): the
+    count-engine world ends in the EXACT state the sort-engine world
+    does — one digest covers every leaf of the class banks."""
+    _set_mode(monkeypatch, "sort")
+    ref = _digest_world(skin, 24)
+    _set_mode(monkeypatch, "count")
+    got = _digest_world(skin, 24)
+    assert ref == got
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("skin", [None, 2.0])
+def test_fused_run_digest_parity_120(monkeypatch, skin):
+    _set_mode(monkeypatch, "sort")
+    ref = _digest_world(skin, 120)
+    _set_mode(monkeypatch, "count")
+    got = _digest_world(skin, 120)
+    assert ref == got
+
+
+# ------------------------------------------------------------ guard rails
+
+def test_binning_mode_validation(monkeypatch):
+    monkeypatch.delenv("NF_BINNING", raising=False)
+    assert binning_mode() == "sort"
+    monkeypatch.setenv("NF_BINNING", "")
+    assert binning_mode() == "sort"
+    monkeypatch.setenv("NF_BINNING", "  ")
+    assert binning_mode() == "sort"
+    monkeypatch.setenv("NF_BINNING", "count")
+    assert binning_mode() == "count"
+    for bad in ("Count", "radix", "cuont"):
+        monkeypatch.setenv("NF_BINNING", bad)
+        with pytest.raises(ValueError, match="NF_BINNING"):
+            binning_mode()
+
+
+def test_dispatch_covers_every_mode(monkeypatch):
+    """Every value in BINNING_MODES must build real tables through BOTH
+    entry points — a mode added to the tuple without a dispatch arm (or
+    vice versa) fails loudly here, not silently at 3am on a chip."""
+    pos, active, feats, sub, sfeats = _case(2, 64, 4, 4.0)
+    for mode in BINNING_MODES:
+        monkeypatch.setenv("NF_BINNING", mode)
+        t = build_cell_table(pos, active, feats, 4.0, 4, 8)
+        assert t.payload.shape[0] == 4 * 4 * 8 + 1
+        pair = build_cell_table_pair(pos, active, feats, sub, sfeats,
+                                     4.0, 4, 8, 4)
+        assert pair[1].bucket == 4
+    # unknown values must raise at the dispatch, not fall through
+    monkeypatch.setenv("NF_BINNING", "bogus")
+    with pytest.raises(ValueError, match="NF_BINNING"):
+        build_cell_table(pos, active, feats, 4.0, 4, 8)
+    with pytest.raises(ValueError, match="NF_BINNING"):
+        build_cell_table_pair(pos, active, feats, sub, sfeats, 4.0, 4, 8, 4)
+
+
+# The counting build path must stay sort-free — that IS the optimisation.
+_COUNT_PATH_FNS = (
+    "_cell_counts",
+    "_counting_ranks",
+    "_counting_slots",
+    "_build_pair_counting",
+    "table_from_slots",
+    "_cell_keys",
+)
+
+
+def _function_defs(tree):
+    return {
+        node.name: node
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def test_counting_path_contains_no_sort():
+    src = (PKG / "ops" / "stencil.py").read_text()
+    defs = _function_defs(ast.parse(src))
+    missing = [f for f in _COUNT_PATH_FNS if f not in defs]
+    assert not missing, f"count-path functions renamed? {missing}"
+    offenses = []
+    for fname in _COUNT_PATH_FNS:
+        for node in ast.walk(defs[fname]):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted and "sort" in dotted.lower():
+                offenses.append(f"{fname}:{node.lineno}: {dotted}()")
+    assert not offenses, "\n".join(offenses)
+
+
+def test_env_read_only_inside_binning_mode():
+    """NF_BINNING is read in exactly one place: stencil.binning_mode().
+    Any other read (os.environ.get / os.getenv / os.environ[...] with the
+    literal or with ENV_BINNING) would fork the dispatch and let the two
+    sites disagree mid-trace."""
+
+    def _mentions_env(node):
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(a, ast.Constant) and a.value == "NF_BINNING":
+                return True
+            if isinstance(a, ast.Name) and a.id == "ENV_BINNING":
+                return True
+        return False
+
+    offenses = []
+    for path in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        # map node -> enclosing function name
+        enclosing = {}
+        for fn in ast.walk(tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for child in ast.walk(fn):
+                    enclosing.setdefault(child, fn.name)
+        for node in ast.walk(tree):
+            hit = None
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func) or ""
+                if dotted.endswith(("environ.get", "getenv")) and \
+                        _mentions_env(node):
+                    hit = dotted
+            elif isinstance(node, ast.Subscript):
+                dotted = _dotted(node.value) or ""
+                sl = node.slice
+                if dotted.endswith("environ") and isinstance(
+                        sl, (ast.Constant, ast.Name)):
+                    v = sl.value if isinstance(sl, ast.Constant) else None
+                    nm = sl.id if isinstance(sl, ast.Name) else None
+                    if v == "NF_BINNING" or nm == "ENV_BINNING":
+                        hit = dotted + "[...]"
+            if hit is None:
+                continue
+            fn = enclosing.get(node)
+            if path.name == "stencil.py" and fn == "binning_mode":
+                continue
+            offenses.append(
+                f"{path.relative_to(PKG.parent)}:{node.lineno}: {hit}"
+            )
+    assert not offenses, "\n".join(offenses)
+    # and the sanctioned read must actually exist (the guard is useless
+    # if a refactor moves the read and nothing asserts where it went)
+    assert stencil.ENV_BINNING == "NF_BINNING"
+
+
+def test_sub_overflow_independent_of_full(monkeypatch):
+    """A row that overflows the FULL table can still hold a valid SUB
+    slot (the subset re-ranks independently) — in both engines."""
+    n = 40
+    pos = jnp.broadcast_to(jnp.float32([2.0, 2.0]), (n, 2))  # one cell
+    active = jnp.ones(n, bool)
+    # sub members are the LAST rows: all overflow the size-4 full table,
+    # but the first 4 of them fit the size-4 sub table
+    sub = jnp.arange(n) >= n - 8
+    feats = jnp.asarray(np.arange(n * 2, dtype=np.float32).reshape(n, 2))
+    for mode in BINNING_MODES:
+        monkeypatch.setenv("NF_BINNING", mode)
+        full, subt = build_cell_table_pair(
+            pos, active, feats, sub, feats, 4.0, 4, 4, 4
+        )
+        assert int(full.dropped) == n - 4
+        assert int(subt.dropped) == 4  # 8 members, 4 slots
+        # the sub winners are the 4 smallest row ids AMONG sub members
+        placed = np.asarray(subt.slot_of[sub])
+        dump = 4 * 4 * 4
+        assert (np.sort(placed[placed < dump]) ==
+                np.asarray(subt.slot_of)[n - 8:n - 4]).all()

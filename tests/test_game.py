@@ -318,3 +318,42 @@ def test_benchmark_world_progresses():
     assert alive.sum() == 500
     maxhp = np.asarray(k.store.column(k.state, "NPC", "MAXHP"))
     assert (maxhp[alive] == 100).all()
+
+
+def test_nine_group_recompute_parity(small_world):
+    """The reference folds NINE NPG_* contribution groups
+    (NFCPropertyModule.cpp:193-240); the record bank is sized from
+    PropertyGroup.ALL so FIGHTING_HERO and TALENT rows must (a) exist,
+    (b) be summed by the DEVICE phase, and (c) agree with the host-side
+    recompute_now fold — one fixture pins all three."""
+    w = small_world
+    g = w.kernel.create_object("Player", {"Job": 0, "Level": 1}, scene=1)
+    contributions = {
+        PropertyGroup.JOBLEVEL: 12,
+        PropertyGroup.EFFECTVALUE: 1,
+        PropertyGroup.REBIRTH_ADD: 2,
+        PropertyGroup.EQUIP: 5,
+        PropertyGroup.EQUIP_AWARD: 4,
+        PropertyGroup.STATIC_BUFF: 3,
+        # RUNTIME_BUFF stays 0: device-owned by BuffModule
+        PropertyGroup.FIGHTING_HERO: 7,
+        PropertyGroup.TALENT: 6,
+    }
+    assert len(contributions) + 1 == int(PropertyGroup.ALL)
+    for grp, val in contributions.items():
+        w.properties.set_group_value(g, "ATK_VALUE", grp, val)
+        assert w.properties.get_group_value(g, "ATK_VALUE", grp) == val
+    expect = sum(contributions.values())
+    # host fold first (read-after-write path)...
+    w.properties.recompute_now(g)
+    assert w.kernel.get_property(g, "ATK_VALUE") == expect
+    # ...then the device phase must land on the same sum
+    w.tick()
+    assert w.kernel.get_property(g, "ATK_VALUE") == expect
+    # dropping the two NEW groups subtracts exactly their contribution —
+    # proves they are real rows, not aliases of the original seven
+    w.properties.set_group_value(
+        g, "ATK_VALUE", PropertyGroup.FIGHTING_HERO, 0)
+    w.properties.set_group_value(g, "ATK_VALUE", PropertyGroup.TALENT, 0)
+    w.tick()
+    assert w.kernel.get_property(g, "ATK_VALUE") == expect - 13

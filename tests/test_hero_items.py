@@ -105,7 +105,7 @@ def test_wear_skill_must_be_owned(world, player):
 
 
 def test_fight_lineup_positions_sum_stats(world, player):
-    """Multiple battle positions: the EQUIP_AWARD fold sums every
+    """Multiple battle positions: the FIGHTING_HERO fold sums every
     positioned hero's config stats x level (PlayerFightHero record)."""
     define_heroes(world)
     e = world.kernel.elements
@@ -119,12 +119,12 @@ def test_fight_lineup_positions_sum_stats(world, player):
     assert h.fight_hero(player, 0) == r1
     assert h.fight_hero(player, 1) == r2
     got = world.properties.get_group_value(
-        player, "ATK_VALUE", PropertyGroup.EQUIP_AWARD)
+        player, "ATK_VALUE", PropertyGroup.FIGHTING_HERO)
     assert got == 4 + 1  # both level 1
     # leveling a positioned hero refreshes the fold
     h.add_hero_exp(player, r1, 200)  # level 1 -> 2
     got = world.properties.get_group_value(
-        player, "ATK_VALUE", PropertyGroup.EQUIP_AWARD)
+        player, "ATK_VALUE", PropertyGroup.FIGHTING_HERO)
     assert got == 4 * 2 + 1
     # re-placing a position overwrites it
     assert h.set_fight_hero(player, r2, pos=0)

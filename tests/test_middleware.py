@@ -109,13 +109,13 @@ def test_hero_collect_level_fight_stats(world, player):
     assert h.add_hero(player, "hero_knight") == row  # dedupe
     assert h.set_fight_hero(player, row)
     assert world.properties.get_group_value(
-        player, "ATK_VALUE", PropertyGroup.EQUIP_AWARD) == 5  # level 1
+        player, "ATK_VALUE", PropertyGroup.FIGHTING_HERO) == 5  # level 1
     # progressive curve (NFIHeroModule.h): level N->N+1 costs (N+1)*100,
     # so 1000 exp from level 1 = 200+300+400 spent -> level 4, 100 left
     lvl = h.add_hero_exp(player, row, 1000)
     assert lvl == 4
     assert world.properties.get_group_value(
-        player, "ATK_VALUE", PropertyGroup.EQUIP_AWARD) == 20
+        player, "ATK_VALUE", PropertyGroup.FIGHTING_HERO) == 20
 
 
 # ---------------------------------------------------------------- task

@@ -253,3 +253,64 @@ def test_sharded_world_checkpoint_roundtrip(tmp_path):
     sk2 = ShardedKernel(w2.kernel, n_devices=8)
     sk2.place()
     sk2.run_device(5)  # resumed world re-shards and keeps ticking
+
+
+def test_sharded_kernel_drops_traces_on_invalidate(world):
+    """Trace-generation sync: kernel.invalidate() (bucket resize, phase
+    swap) must flush the ShardedKernel's jit caches too, else the mesh
+    keeps ticking the STALE program — CombatModule's overflow auto-resize
+    would silently never take effect under a mesh."""
+    sk = ShardedKernel(world.kernel, n_devices=N_DEV)
+    sk.place()
+    sk.tick()
+    f_step = sk._jit_step
+    assert f_step is not None
+    world.kernel.invalidate()
+    sk.tick()
+    assert sk._jit_step is not None and sk._jit_step is not f_step
+    # run_device syncs the same way
+    sk.run_device(2)
+    f_run = sk._jit_run
+    world.kernel.set_phases(world.kernel.phases)
+    sk.run_device(2)
+    assert sk._jit_run is not f_run
+
+
+def test_sharded_combat_overflow_resize_takes_effect():
+    """End to end under the mesh: everyone piled into one cell with a
+    bucket of 1 overflows; CombatModule doubles the bucket + invalidates,
+    the generation sync retraces the SHARDED tick, and the drops stop —
+    the r05 capture showed grid_overflow_max=374 silently dropped because
+    the old mesh kept its stale trace."""
+    w = GameWorld(WorldConfig(
+        combat=True, movement=False, regen=False, middleware=False,
+        npc_capacity=64, player_capacity=8, extent=64.0,
+        aoe_radius=8.0, aoi_bucket=1,
+        attack_period_s=1.0 / 30.0, respawn_s=1e6,
+    )).start()
+    w.scene.create_scene(1)
+    w.seed_npcs(32)
+    k = w.kernel
+    host = k.store._hosts["NPC"]
+    for row in np.flatnonzero(host.alloc_mask):
+        k.set_property(host.row_guid[int(row)], "Position",
+                       (10.0, 10.0, 0.0))
+    c = w.combat
+    assert c.auto_resize
+    c.max_bucket_boost = 64  # headroom for 32 piled into bucket 1
+    sk = ShardedKernel(k, n_devices=N_DEV)
+    sk.place()
+    for _ in range(20):
+        sk.tick()
+        if c._bucket_boost >= 32:
+            break
+    assert c._bucket_boost >= 32, "mesh never picked up the resize"
+    assert c.overflow_alerts >= 1
+    # the grown bucket holds all 32 entities: the overflow event stops
+    # firing, so the running total freezes (overflow_last is reset by the
+    # GameWorld.tick module-execute loop, which sk.tick() bypasses)
+    sk.tick()
+    before = c.overflow_total
+    sk.tick()
+    sk.tick()
+    assert c.overflow_total == before

@@ -341,3 +341,117 @@ def test_spatial_single_shard_degenerate():
     assert len(got) == n
     assert sum(1 for _, (_, _, h) in got.items() if h < 100) > n // 2
     assert world.stats_last.sum() == 0
+
+
+def test_spatial_auto_resize_stops_bucket_drops():
+    """SpatialGeom twin of CombatModule's overflow auto-resize: a pile-up
+    in one cell with bucket 1 breaches the budget, both buckets double
+    (bounded) with a retrace, and the drops actually STOP."""
+    geom = SpatialGeom(
+        extent=128.0, cell_size=16.0, width=8, n_shards=2,
+        bucket=1, att_bucket=1, radius=4.0, mig_budget=64,
+        speed=0.0, attack_period=1,
+    )
+    n = 64
+    rng = np.random.default_rng(21)
+    # everyone inside ONE cell (same slab), zero speed: pure pile-up
+    pos = rng.uniform(33.0, 40.0, (n, 2)).astype(np.float32)
+    hp = np.full(n, 100000, np.int32)
+    atk = np.ones(n, np.int32)
+    camp = (np.arange(n) % 2).astype(np.int32)
+    world = SpatialWorld(geom)
+    world.max_bucket_boost = 256
+    world.place(pos, hp, atk, camp)
+    for _ in range(20):
+        world.step()
+        if world.geom.bucket >= n:
+            break
+    assert world._bucket_boost > 1, "budget breach never resized"
+    assert world.geom.bucket >= n and world.geom.att_bucket >= n
+    assert world.overflow_alerts >= 1
+    world.step()
+    world.step()
+    assert world.stats_last[:, 4:].sum() == 0, world.stats_last
+
+
+def test_spatial_auto_resize_disabled_keeps_geometry():
+    geom = SpatialGeom(
+        extent=128.0, cell_size=16.0, width=8, n_shards=2,
+        bucket=1, att_bucket=1, radius=4.0, mig_budget=64,
+        speed=0.0, attack_period=1,
+    )
+    n = 32
+    pos = np.random.default_rng(22).uniform(
+        33.0, 40.0, (n, 2)).astype(np.float32)
+    world = SpatialWorld(geom)
+    world.auto_resize = False
+    world.place(pos, np.full(n, 10000, np.int32),
+                np.ones(n, np.int32), (np.arange(n) % 2).astype(np.int32))
+    for _ in range(4):
+        world.step()
+    assert world.geom.bucket == 1 and world._bucket_boost == 1
+    assert world.stats_last[:, 4:].sum() > 0  # drops persist, by choice
+
+
+def test_spatial_binning_count_bit_parity(monkeypatch):
+    """The slab shards' per-shard table build through NF_BINNING=count:
+    same positions and HP as the sort engine, tick for tick."""
+    geom, pos, hp, atk, camp = _mk_world(n=600, seed=8, n_shards=2,
+                                         mig_budget=256)
+    ticks = 12
+    results = {}
+    for mode in ("sort", "count"):
+        if mode == "sort":
+            monkeypatch.delenv("NF_BINNING", raising=False)
+        else:
+            monkeypatch.setenv("NF_BINNING", mode)
+        world = SpatialWorld(geom)
+        world.place(pos, hp, atk, camp)
+        world.step(ticks)
+        results[mode] = world.gather()
+    assert results["sort"].keys() == results["count"].keys()
+    for g, (x, y, hp_) in results["sort"].items():
+        cx, cy, chp = results["count"][g]
+        assert hp_ == chp, f"gid {g} hp"
+        np.testing.assert_array_equal(np.float32([x, y]),
+                                      np.float32([cx, cy]))
+
+
+def test_spatial_snapshot_cross_engine_drops_verlet_cache(
+        tmp_path, monkeypatch):
+    """A snapshot saved under one NF_BINNING engine loads under the other
+    with its Verlet-cache leaves zeroed (the cached order/skey/slot are
+    engine-specific), forcing a first-tick rebuild — and the resumed
+    trajectory stays bit-identical to an unbroken run."""
+    geom, pos, hp, atk, camp = _mk_world(n=400, seed=12, n_shards=2,
+                                         cell_size=8.0, width=16,
+                                         radius=4.0, speed=0.1, skin=4.0)
+    monkeypatch.delenv("NF_BINNING", raising=False)
+    world = SpatialWorld(geom)
+    world.place(pos, hp, atk, camp)
+    world.step(6)
+    p = str(tmp_path / "snap.npz")
+    world.save(p)
+    # unbroken oracle, still under sort
+    world.step(6)
+    ref = world.gather()
+
+    monkeypatch.setenv("NF_BINNING", "count")
+    w2 = SpatialWorld(geom)
+    w2.load(p)
+    # cross-engine load: the anchor must be fully invalidated
+    assert not np.asarray(w2.state.vc_active).any()
+    w2.step(6)
+    got = w2.gather()
+    assert ref.keys() == got.keys()
+    for g, (x, y, hp_) in ref.items():
+        cx, cy, chp = got[g]
+        assert hp_ == chp, f"gid {g} hp"
+        np.testing.assert_array_equal(np.float32([x, y]),
+                                      np.float32([cx, cy]))
+
+    # same-engine load keeps the cache (the cheap path stays cheap)
+    monkeypatch.delenv("NF_BINNING", raising=False)
+    w3 = SpatialWorld(geom)
+    w3.load(p)
+    assert np.asarray(w3.state.vc_active).any()

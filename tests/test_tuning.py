@@ -99,6 +99,48 @@ def test_error_payloads_are_ignored(tmp_path, capsys):
     assert got["env"] == {}  # a 10x "win" from a crash payload is not real
 
 
+def test_binning_count_elected_when_it_beats_margin(tmp_path, capsys):
+    """The r07 A/B: count wins against its OWN pinned sort baseline."""
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r07_tpu_1m.json", 95.0)        # pinned NF_BINNING=sort
+    _w(tmp_path, "r07_tpu_1m_count.json", 80.0)  # beats 95 * 0.97
+    got = _run(mod, capsys)
+    assert got["env"] == {"NF_BINNING": "count"}
+    assert got["detail"]["binning_sort_tick_ms"] == 95.0
+    assert got["detail"]["binning_count_tick_ms"] == 80.0
+
+
+def test_binning_within_margin_keeps_sort(tmp_path, capsys):
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r07_tpu_1m.json", 95.0)
+    _w(tmp_path, "r07_tpu_1m_count.json", 93.0)  # within 3%: tie -> default
+    got = _run(mod, capsys)
+    assert "NF_BINNING" not in got["env"]
+
+
+def test_binning_compares_against_round_baseline_when_r07_sort_missing(
+        tmp_path, capsys):
+    """No pinned r07 sort capture: fall back to the round baseline rather
+    than electing against nothing (a crashed sort run must not hand the
+    election to count by default)."""
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r07_tpu_1m_count.json", 90.0)
+    got = _run(mod, capsys)
+    assert got["env"] == {"NF_BINNING": "count"}
+    assert got["detail"]["binning_sort_tick_ms"] == 100.0
+
+
+def test_binning_error_capture_not_elected(tmp_path, capsys):
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r07_tpu_1m_count.json", 10.0, error="oom")
+    got = _run(mod, capsys)
+    assert "NF_BINNING" not in got["env"]
+
+
 def test_bench_applies_tuning_env(tmp_path, monkeypatch):
     """bench.py's loader: setdefault semantics (explicit env wins)."""
     runs = tmp_path / "bench_runs"
