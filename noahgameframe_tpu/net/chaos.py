@@ -36,10 +36,16 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import time as _time
 import zlib
 from typing import Dict, List, Optional, Tuple
 
 from .transport import EV_CONNECTED, EV_DISCONNECTED, EV_MSG, NetEvent
+
+
+class StoreFaultError(ConnectionError):
+    """Injected store failure (the write-behind flusher retries these
+    exactly like a real connection error)."""
 
 
 @dataclasses.dataclass
@@ -69,6 +75,31 @@ class LinkFaults:
 
 
 @dataclasses.dataclass
+class StoreFaults:
+    """Per-store-link fault schedule for the persistence flush path.
+
+    The clock here is the link's *operation count* (one tick per store
+    call), mirroring how transport faults use poll counts: schedules
+    stay deterministic without wall time.  Probabilistic faults draw
+    from the same shared per-link rng the director owns, so budgets and
+    sequences survive pipeline rebuilds on revive exactly like
+    transport wrappers survive re-dials."""
+
+    fail: float = 0.0        # store call raises StoreFaultError
+    # refuse the first N calls outright — the deterministic retry
+    # exercise (budget lives in the shared counts, then heals for good)
+    fail_first: int = 0
+    latency: float = 0.0     # store call sleeps `latency_s` first
+    latency_s: float = 0.05  # flusher-thread sleep; never the tick path
+    # [start_op, end_op) windows where the store is down hard
+    down: Tuple[Tuple[int, int], ...] = ()
+
+    def any(self) -> bool:
+        return bool(self.fail or self.fail_first or self.latency
+                    or self.down)
+
+
+@dataclasses.dataclass
 class FaultPlan:
     """A seeded schedule of per-link faults.
 
@@ -81,12 +112,23 @@ class FaultPlan:
     seed: int = 0
     links: Dict[str, LinkFaults] = dataclasses.field(default_factory=dict)
     default: LinkFaults = dataclasses.field(default_factory=LinkFaults)
+    # store links (names look like "game6.store") follow the same
+    # pattern-match discipline as message links
+    stores: Dict[str, StoreFaults] = dataclasses.field(default_factory=dict)
+    store_default: StoreFaults = dataclasses.field(
+        default_factory=StoreFaults)
 
     def for_link(self, link: str) -> LinkFaults:
         for pattern, faults in self.links.items():
             if pattern in link:
                 return faults
         return self.default
+
+    def for_store(self, link: str) -> StoreFaults:
+        for pattern, faults in self.stores.items():
+            if pattern in link:
+                return faults
+        return self.store_default
 
 
 class FaultyTransport:
@@ -234,6 +276,75 @@ class FaultyTransport:
         return out
 
 
+class FaultyStore:
+    """Write-behind store backend wrapper applying :class:`StoreFaults`
+    to one store link.
+
+    Sits where the flusher thread talks to the store (the
+    ``StoreBackend`` seam in :mod:`persist.writebehind`): ``write`` /
+    ``delete`` pass through the fault schedule; everything else
+    delegates.  Injected latency sleeps on the *flusher* thread — the
+    whole point of write-behind is that this never reaches the tick,
+    and the persist smoke asserts exactly that.
+    """
+
+    def __init__(self, inner, link: str, plan: FaultPlan,
+                 counts: Optional[Dict[str, int]] = None,
+                 log: Optional[list] = None,
+                 rng: Optional[random.Random] = None) -> None:
+        self.inner = inner
+        self.link = str(link)
+        self.faults = plan.for_store(self.link)
+        self.rng = rng if rng is not None else random.Random(
+            (int(plan.seed) * 1000003) ^ zlib.crc32(self.link.encode())
+        )
+        self.counts = counts if counts is not None else {}
+        self.log = log
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _count(self, kind: str) -> None:
+        self.counts[kind] = self.counts.get(kind, 0) + 1
+        if self.log is not None:
+            self.log.append((self.counts.get("store_op", 0), self.link,
+                             kind, 0))
+
+    def _down(self, op: int) -> bool:
+        return any(a <= op < b for a, b in self.faults.down)
+
+    def _guard(self) -> None:
+        f, r = self.faults, self.rng
+        op = self.counts.get("store_op", 0)
+        self.counts["store_op"] = op + 1
+        if self._down(op):
+            self._count("store_down")
+            raise StoreFaultError(f"{self.link}: store down (op {op})")
+        if f.fail_first and self.counts.get("store_fail", 0) < int(
+                f.fail_first):
+            self._count("store_fail")
+            raise StoreFaultError(f"{self.link}: refused (first-N budget)")
+        if f.fail and r.random() < f.fail:
+            self._count("store_fail")
+            raise StoreFaultError(f"{self.link}: injected write failure")
+        if f.latency and r.random() < f.latency:
+            self._count("store_latency")
+            _time.sleep(max(0.0, float(f.latency_s)))
+
+    def write(self, key: str, blob: bytes) -> None:
+        self._guard()
+        return self.inner.write(key, blob)
+
+    def delete(self, key: str) -> None:
+        self._guard()
+        return self.inner.delete(key)
+
+    def ping(self) -> bool:
+        if self._down(self.counts.get("store_op", 0)):
+            return False
+        return self.inner.ping()
+
+
 class ChaosDirector:
     """One per cluster: wraps transports and owns the per-link fault
     counts + logs so they survive transport rebuilds (every reconnect
@@ -249,6 +360,21 @@ class ChaosDirector:
         link = str(link)
         return FaultyTransport(
             transport, link, self.plan,
+            counts=self.counts.setdefault(link, {}),
+            log=self.logs.setdefault(link, []),
+            rng=self.rngs.setdefault(link, random.Random(
+                (int(self.plan.seed) * 1000003) ^ zlib.crc32(link.encode())
+            )),
+        )
+
+    def wrap_store(self, backend, link: str) -> FaultyStore:
+        """Wrap a write-behind store backend the same way `wrap` wraps
+        a transport: counts/log/rng live here, so a revived game role's
+        rebuilt pipeline continues the SAME fault schedule (op counts
+        and first-N budgets do not reset)."""
+        link = str(link)
+        return FaultyStore(
+            backend, link, self.plan,
             counts=self.counts.setdefault(link, {}),
             log=self.logs.setdefault(link, []),
             rng=self.rngs.setdefault(link, random.Random(
@@ -276,5 +402,9 @@ class ChaosDirector:
                 for pattern, faults in self.plan.links.items()
             },
             "default": dataclasses.asdict(self.plan.default),
+            "stores": {
+                pattern: dataclasses.asdict(faults)
+                for pattern, faults in self.plan.stores.items()
+            },
             "counts": {link: dict(c) for link, c in self.counts.items()},
         }

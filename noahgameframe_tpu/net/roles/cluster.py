@@ -201,6 +201,15 @@ class LocalCluster:
                     sd.client = director.wrap(
                         sd.client, f"{rname}.{key}->{sd.server_id}"
                     )
+        # store links: the write-behind flusher's backend gets the same
+        # treatment as a transport — the director owns the op counts and
+        # rng, so a revived role's rebuilt pipeline CONTINUES the fault
+        # schedule instead of restarting it
+        pipeline = getattr(role, "persist", None)
+        if pipeline is not None:
+            pipeline.backend = director.wrap_store(
+                pipeline.backend, f"{rname}.store"
+            )
         role.telemetry.add_chaos_source(director, prefix=f"{rname}.")
         # flight recorder: a recording game role journals the fault-plan
         # seed + link budgets as an epoch note (RNG seeds of everything
@@ -213,6 +222,8 @@ class LocalCluster:
                 seed=int(plan.seed),
                 links={p: dataclasses.asdict(f)
                        for p, f in plan.links.items()},
+                stores={p: dataclasses.asdict(f)
+                        for p, f in plan.stores.items()},
             )
 
     # ----------------------------------------------------- kill / revive

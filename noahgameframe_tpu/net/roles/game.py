@@ -39,11 +39,13 @@ from ...persist.codec import (
     record_row_struct,
     serialize_properties,
     serialize_records,
+    snapshot_object,
 )
-from ..defines import EventCode, MsgID, ServerType
+from ..defines import EventCode, MsgID, ServerState, ServerType
 from ..transport import EV_DISCONNECTED
 from ..wire import (
     AckEventResult,
+    ServerInfoExt,
     AckPlayerEntryList,
     AckPlayerLeaveList,
     AckRoleLiteInfoList,
@@ -161,6 +163,9 @@ class GameRole(ServerRole):
         resume: bool = False,
         journal_dir=None,
         journal_segment_bytes: int = 1 << 20,
+        persist_store=None,
+        persist_wal_dir=None,
+        persist_drain_timeout: float = 3.0,
     ) -> None:
         # (class, prop) diffs with >= batch_sync_min changed rows go out
         # as ONE columnar ACK_BATCH_PROPERTY message per (cell, conn)
@@ -372,6 +377,89 @@ class GameRole(ServerRole):
             )
             self._jrn_sampled = [0, 0, 0]  # bytes, segments, ticks
             self._journal_pump_counters()
+        # write-behind durability (persist/writebehind.py): per-tick
+        # Save-flagged diffs stream to the store off-thread, staged in a
+        # crash-safe WAL.  Built from kwargs (not passed in ready-made)
+        # so LocalCluster.revive_role's kwargs replay reconstructs the
+        # pipeline over the SAME wal dir and recovers queued batches.
+        self.persist = None
+        self._persist_drain_timeout = float(persist_drain_timeout)
+        self._persist_dirty: set = set()
+        self._persist_class = None
+        self._save_props: set = set()
+        self._save_records: set = set()
+        if persist_store is not None and persist_wal_dir is not None:
+            from ...persist.writebehind import WriteBehindPipeline
+
+            self.persist = WriteBehindPipeline(
+                persist_store, persist_wal_dir,
+                registry=self.telemetry.registry,
+                name=f"game{config.server_id}",
+            )
+            if self.data_agent is not None:
+                self.data_agent.pipeline = self.persist
+                self._persist_class = self.data_agent.class_name
+                spec = self.kernel.store.spec(self._persist_class)
+                for slot in spec.slots.values():
+                    p = slot.prop
+                    if not p.flag("save"):
+                        continue
+                    self._save_props.add(p.name)
+                    # own subscriber: harvest is independent of which
+                    # props the sync spine happens to watch
+                    self.kernel.register_property_event(
+                        self._persist_class, p.name, self._persist_prop_change
+                    )
+                    if not (p.public or p.upload):
+                        # save-only columns aren't in diff_flags: opt
+                        # them into device diff extraction or tick-path
+                        # writes would never mark them dirty
+                        self.kernel.force_diff_property(
+                            self._persist_class, p.name
+                        )
+                for rname, rs in spec.records.items():
+                    if rs.rec.flag("save"):
+                        self._save_records.add(rname)
+                        self.kernel.register_record_diff(
+                            self._persist_class, rname,
+                            self._persist_rec_diff,
+                        )
+                self.kernel.subscribe_record_host(self._persist_rec_host)
+
+    def _persist_prop_change(self, cname: str, pname: str, rows) -> None:
+        self._persist_dirty.update(int(r) for r in rows)
+
+    def _persist_rec_diff(self, cname: str, rname: str, codes) -> None:
+        self._persist_dirty.update(int(e) for e in np.nonzero(
+            np.any(codes != 0, axis=1))[0])
+
+    def _persist_rec_host(self, cname, rname, op, erows, rec_row, tags) -> None:
+        if cname == self._persist_class and rname in self._save_records:
+            self._persist_dirty.update(int(e) for e in erows)
+
+    def _persist_harvest(self) -> None:
+        """Stage this tick's dirty Save-flagged entities into the
+        write-behind queue as one coalesced batch.  Pump-thread only;
+        never touches the store (the flusher owns every store call)."""
+        tick = self.kernel.tick_count
+        rows, self._persist_dirty = self._persist_dirty, set()
+        if rows:
+            agent = self.data_agent
+            host = self.kernel.store._hosts[self._persist_class]
+            k = self.kernel
+            items = {}
+            for r in sorted(rows):
+                g = host.row_guid[r] if r < len(host.row_guid) else None
+                if g is None:
+                    continue  # died this tick; the destroy hook saved it
+                key = agent._key_of(g)
+                if key is None:
+                    continue
+                items[key] = snapshot_object(k.store, k.state, g, agent.flags)
+            if items:
+                self.persist.enqueue(tick, items)
+        self.persist.note_tick(tick)
+        self.persist.pump()
 
     def _journal_tap(self, source: int):
         def tap(ev) -> None:
@@ -400,6 +488,31 @@ class GameRole(ServerRole):
         budgets, config flips) — no-op when not recording."""
         if self.journal is not None:
             self.journal.note(info)
+
+    def report(self):
+        """Heartbeat report, extended with write-behind health: lag +
+        degraded ride the ext map to the master's /json and status page
+        (the SUSPECT-surfacing leg of the durability story), and a
+        degraded store flips the advertised state to BUSY so balancers
+        steer new logins elsewhere while the world stays up."""
+        if self.persist is not None and self.state in (
+                int(ServerState.NORMAL), int(ServerState.BUSY)):
+            self.state = (int(ServerState.BUSY) if self.persist.degraded()
+                          else int(ServerState.NORMAL))
+        r = super().report()
+        if self.persist is not None:
+            ext = r.server_info_list_ext
+            if ext is None:
+                ext = ServerInfoExt()
+                r.server_info_list_ext = ext
+            for k, v in (
+                ("persist_lag_ticks", self.persist.lag_ticks()),
+                ("persist_queue_depth", self.persist.queue_depth()),
+                ("persist_degraded", int(self.persist.degraded())),
+            ):
+                ext.key.append(k.encode())
+                ext.value.append(str(v).encode())
+        return r
 
     def _install(self) -> None:
         s = self.server
@@ -1398,6 +1511,11 @@ class GameRole(ServerRole):
                     self.kernel.last_counters.get("state_digest", 0),
                 )
                 self._journal_pump_counters()
+            if self.persist is not None:
+                # stage this tick's dirty set; all store I/O stays on
+                # the flusher thread (the smoke asserts the tick never
+                # blocks even with injected store latency)
+                self._persist_harvest()
         # _interest_dirty alone must also trigger a flush: a destroy with
         # no property diff still changes visible sets (gone lists)
         if self._changed or self._rec_changed or self._interest_dirty:
@@ -1434,13 +1552,33 @@ class GameRole(ServerRole):
             # a recoverable replay basis
             self.journal.checkpoint_mark(self.kernel.tick_count)
             self._journal_pump_counters()
+        if self.persist is not None:
+            # same durability point for the write-behind WAL: after this
+            # fsync the newest (checkpoint, WAL suffix) pair on disk is
+            # mutually recoverable
+            self.persist.barrier(self.kernel.tick_count)
         return self.checkpoint_dir
 
     def shut(self) -> None:
+        # pending-save drain: stage every live session player BEFORE the
+        # sockets come down, then give the flusher a bounded window to
+        # empty the queue — anything still unflushed (store down) stays
+        # durable in the WAL for the next pipeline over this directory
+        if self.persist is not None and self.data_agent is not None:
+            for sess in self.sessions.values():
+                if (sess.guid is not None
+                        and sess.guid in self.kernel.store.guid_map):
+                    self.data_agent.save(sess.guid)
         super().shut()
         if self.journal is not None:
             self.journal.close()
             self.journal = None
+        if self.persist is not None:
+            self.persist.drain(self._persist_drain_timeout)
+            self.persist.close()
+            if self.data_agent is not None:
+                self.data_agent.pipeline = None
+            self.persist = None
 
     def _queue_change(self, cname: str, pname: str, rows: np.ndarray) -> None:
         """Property-event sink: accumulate changed rows per (class, prop);

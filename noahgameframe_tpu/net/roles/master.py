@@ -275,19 +275,27 @@ class MasterRole(ServerRole):
                 endpoint = html.escape(f"{s['ip']}:{s['port']}")
                 lease = html.escape(str(s.get("lease", "?")))
                 age = s.get("last_seen_age_s", 0.0)
+                ext = s.get("ext", {})
+                if "persist_lag_ticks" in ext:
+                    persist = f"lag {html.escape(str(ext['persist_lag_ticks']))}"
+                    if str(ext.get("persist_degraded", "0")) != "0":
+                        persist += " <b>DEGRADED</b>"
+                else:
+                    persist = "&mdash;"
                 rows.append(
                     f"<tr><td>{html.escape(group)}</td><td>{s['server_id']}</td>"
                     f"<td>{name}</td><td>{endpoint}</td>"
                     f"<td>{s['cur_count']}/{s['max_online']}</td>"
                     f"<td>{html.escape(str(state))}</td>"
-                    f"<td>{lease} ({age:.1f}s)</td></tr>"
+                    f"<td>{lease} ({age:.1f}s)</td>"
+                    f"<td>{persist}</td></tr>"
                 )
         return (
             "<html><head><title>cluster status</title></head><body>"
             "<h2>Cluster status</h2>"
             "<table border=1 cellpadding=4><tr><th>role</th><th>id</th>"
             "<th>name</th><th>endpoint</th><th>load</th><th>state</th>"
-            "<th>lease (heartbeat age)</th></tr>"
+            "<th>lease (heartbeat age)</th><th>persist</th></tr>"
             + "".join(rows)
             + "</table><p><a href='/json'>raw json</a></p></body></html>"
         )

@@ -8,3 +8,11 @@ from .mysql import MiniMysql, MysqlClient, MysqlError, MysqlModule  # noqa: F401
 from .resp import MiniRedisServer, RespKV  # noqa: F401
 from .social import SocialDataAgent  # noqa: F401
 from .sql import SqlModule, emit_ddl  # noqa: F401
+from .writebehind import (  # noqa: F401
+    KVBackend,
+    SqlBackend,
+    StagingWAL,
+    StoreBackend,
+    WALError,
+    WriteBehindPipeline,
+)

@@ -43,6 +43,11 @@ class PlayerDataAgent:
         self.flags = flags
         self.kernel: Optional[Kernel] = None
         self._key_fn = key_fn
+        # optional write-behind pipeline (persist.writebehind): when
+        # set, saves stage through its WAL-backed queue instead of
+        # calling the store inline — a destroy during a store outage is
+        # durable in the WAL instead of silently lost
+        self.pipeline = None
         # OBJECT refs whose targets weren't loaded yet (e.g. a player's
         # GuildID applied before the guild entity exists); re-resolved on
         # every subsequent load and via resolve_refs()
@@ -76,7 +81,18 @@ class PlayerDataAgent:
         key = self._key_of(guid)
         if key is None:
             return False
-        blob = self.kv.get(key)
+        blob = None
+        if self.pipeline is not None:
+            # read-your-writes: a save still queued (store down, or the
+            # flusher simply hasn't reached it) must win over the
+            # store's stale copy; a queued tombstone means "no blob"
+            queued, pend = self.pipeline.pending(key)
+            if queued:
+                blob = pend
+                if blob is None:
+                    return False
+        if blob is None:
+            blob = self.kv.get(key)
         if blob is None:
             return False
         k = self.kernel
@@ -98,16 +114,32 @@ class PlayerDataAgent:
         if key is None:
             return False
         k = self.kernel
-        self.kv.set(key, snapshot_object(k.store, k.state, guid, self.flags))
+        blob = snapshot_object(k.store, k.state, guid, self.flags)
+        if self.pipeline is not None:
+            self.pipeline.enqueue_one(key, blob)
+        else:
+            self.kv.set(key, blob)
         return True
 
     def exists(self, key: str) -> bool:
         """key is the suffix after the prefix, e.g. "account:RoleName"."""
-        return self.kv.exists(self.key_prefix + key)
+        full = self.key_prefix + key
+        if self.pipeline is not None:
+            queued, pend = self.pipeline.pending(full)
+            if queued:
+                return pend is not None
+        return self.kv.exists(full)
 
     def delete(self, key: str) -> bool:
-        """Drop a character's blob (role deletion)."""
-        return self.kv.delete(self.key_prefix + key)
+        """Drop a character's blob (role deletion).  With a pipeline the
+        delete is a queued tombstone: it supersedes any older queued
+        save (no resurrection) and reaches the store durably."""
+        full = self.key_prefix + key
+        if self.pipeline is not None:
+            self.pipeline.discard(full)
+            self.pipeline.enqueue_one(full, None)
+            return True
+        return self.kv.delete(full)
 
 
 class RoleListStore:

@@ -25,7 +25,10 @@ from pathlib import Path
 import pytest
 
 PKG = Path(__file__).resolve().parent.parent / "noahgameframe_tpu"
-SCANNED_DIRS = ("kernel", "ops", "game")
+# persist/ rides along (ISSUE 6): write-behind batch identity (seq, tick)
+# must never include a wall clock — recovery flushes have to be
+# byte-identical to the flushes a crash interrupted
+SCANNED_DIRS = ("kernel", "ops", "game", "persist")
 
 
 def _files():
@@ -152,3 +155,78 @@ def test_linter_catches(src, tmp_path):
 ])
 def test_linter_allows(src, tmp_path):
     assert not _lint_source(src, tmp_path), src
+
+
+# --- write-behind thread contract (ISSUE 6): the pump-thread surface of
+# WriteBehindPipeline must never touch the store or sleep — the compiled
+# tick cannot be allowed to block on a socket — and only barrier/drain/
+# close may fsync the WAL (enqueue/pump run every tick; an fsync there
+# would put disk latency on the tick path).
+WB_PATH = PKG / "persist" / "writebehind.py"
+PUMP_METHODS = {"enqueue", "enqueue_one", "note_tick", "barrier", "pump",
+                "pending", "discard", "lag_ticks", "queue_depth",
+                "degraded"}
+SYNC_ALLOWED = {"barrier", "drain", "close", "kill"}
+
+
+def _pipeline_methods():
+    tree = ast.parse(WB_PATH.read_text(), filename=str(WB_PATH))
+    cls = next(
+        n for n in tree.body
+        if isinstance(n, ast.ClassDef) and n.name == "WriteBehindPipeline"
+    )
+    return {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
+
+
+def _calls(fn):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            if dotted is not None:
+                yield node.lineno, dotted
+
+
+def test_pipeline_declares_expected_pump_surface():
+    missing = PUMP_METHODS - set(_pipeline_methods())
+    assert not missing, f"pump-thread methods vanished: {sorted(missing)}"
+
+
+@pytest.mark.parametrize("method", sorted(PUMP_METHODS))
+def test_pump_surface_never_touches_store_or_sleeps(method):
+    fn = _pipeline_methods()[method]
+    offenses = [
+        f"{method}:{line}: {dotted}"
+        for line, dotted in _calls(fn)
+        if dotted.startswith("self.backend.")
+        or dotted == "self._flush_batch"
+        or dotted.endswith(".sleep") or dotted == "sleep"
+    ]
+    assert not offenses, (
+        "store/sleep call on the pump-thread surface:\n" + "\n".join(offenses)
+    )
+
+
+def test_wal_fsync_only_at_barriers():
+    for name, fn in _pipeline_methods().items():
+        if name in SYNC_ALLOWED:
+            continue
+        offenses = [
+            f"{name}:{line}" for line, dotted in _calls(fn)
+            if dotted in ("self.wal.sync", "os.fsync")
+        ]
+        assert not offenses, (
+            "per-tick WAL fsync (disk latency on the tick path):\n"
+            + "\n".join(offenses)
+        )
+
+
+def test_flusher_owns_every_store_call():
+    methods = _pipeline_methods()
+    callers = {
+        name for name, fn in methods.items()
+        if any(dotted.startswith("self.backend.")
+               for _, dotted in _calls(fn))
+    }
+    # _flush_batch (called only from _run, the flusher thread) is the
+    # single place store I/O happens
+    assert callers == {"_flush_batch"}, callers

@@ -1,0 +1,483 @@
+"""Write-behind persistence (ISSUE 6).
+
+Covers the durability stack bottom-up: batch codec round-trips, staging
+WAL recovery/rotation/pruning, fail-closed framing under corruption (the
+persistence sibling of test_replay's journal fuzz — torn NEWEST tail is
+the one tolerated crash artifact), pipeline semantics (flush, retry,
+bounded-queue coalescing, kill/recover idempotence), agent routing
+through the queue, and — via scripts/persist_smoke.py — the full
+kill-under-write → revive-from-(checkpoint, WAL) e2e.
+"""
+
+import importlib.util
+import struct
+import sys
+import time
+import zlib
+from pathlib import Path
+
+import pytest
+
+from noahgameframe_tpu.net.retry import RetryPolicy
+from noahgameframe_tpu.persist import (
+    KVBackend,
+    StagingWAL,
+    StoreBackend,
+    WALError,
+    WriteBehindPipeline,
+)
+from noahgameframe_tpu.persist.kv import MemoryKV
+from noahgameframe_tpu.persist.writebehind import (
+    HEADER,
+    MAX_RECORD_SIZE,
+    WAL_MAGIC,
+    WB_BATCH,
+    Batch,
+    decode_batch,
+    encode_batch,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "scripts" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _wait(cond, timeout=5.0, step=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+class FlakyStore(StoreBackend):
+    """In-memory backend with a switchable failure mode (the unit-test
+    stand-in for chaos.FaultyStore)."""
+
+    def __init__(self):
+        self.data = {}
+        self.fail = False
+        self.ops = 0
+
+    def write(self, key, blob):
+        self.ops += 1
+        if self.fail:
+            raise IOError("store down")
+        self.data[key] = blob
+
+    def delete(self, key):
+        self.ops += 1
+        if self.fail:
+            raise IOError("store down")
+        self.data.pop(key, None)
+
+
+def _pipeline(store, wal_dir, **kw):
+    kw.setdefault("retry", RetryPolicy(base=0.002, cap=0.01, seed=3))
+    kw.setdefault("name", "t")
+    return WriteBehindPipeline(store, wal_dir, **kw)
+
+
+# ----------------------------------------------------------- batch codec
+class TestBatchCodec:
+    def test_round_trip_puts_and_tombstones(self):
+        b = Batch(5, 42, {"obj:a:A": b"\x00blob", "obj:b:B": None, "": b""})
+        out = decode_batch(encode_batch(b))
+        assert (out.seq, out.tick) == (5, 42)
+        assert out.entries == b.entries
+
+    def test_trailing_bytes_fail_closed(self):
+        body = encode_batch(Batch(1, 1, {"k": b"v"})) + b"\x00"
+        with pytest.raises(WALError):
+            decode_batch(body)
+
+    def test_truncated_entry_fails_closed(self):
+        body = encode_batch(Batch(1, 1, {"k": b"value"}))
+        with pytest.raises(WALError):
+            decode_batch(body[:-3])
+
+    def test_merge_older_newest_wins(self):
+        new = Batch(3, 30, {"a": b"new", "c": None})
+        new.merge_older(Batch(2, 20, {"a": b"old", "b": b"keep"}))
+        assert new.entries == {"a": b"new", "b": b"keep", "c": None}
+
+
+# ----------------------------------------------------------- staging WAL
+class TestStagingWAL:
+    def test_recovery_returns_unmarked_suffix(self, tmp_path):
+        w = StagingWAL(tmp_path / "w")
+        for seq in (1, 2, 3):
+            w.append_batch(Batch(seq, seq * 10, {f"k{seq}": b"v"}))
+        w.mark(1, 10)
+        w.close()
+        r = StagingWAL(tmp_path / "w")
+        assert [b.seq for b in r.pending] == [2, 3]
+        assert (r.flushed_seq, r.flushed_tick) == (1, 10)
+        r.close()
+
+    def test_rotation_and_numbering_resume(self, tmp_path):
+        w = StagingWAL(tmp_path / "w", segment_bytes=4096)
+        for seq in range(1, 40):
+            w.append_batch(Batch(seq, seq, {f"k{seq}": bytes(200)}))
+        w.close()
+        segs = sorted((tmp_path / "w").glob("wal-*.nfw"))
+        assert len(segs) >= 2, "rotation never happened"
+        r = StagingWAL(tmp_path / "w", segment_bytes=4096)
+        assert [b.seq for b in r.pending] == list(range(1, 40))
+        # the resumed writer opens a NEW segment, never clobbers one
+        assert len(sorted((tmp_path / "w").glob("wal-*.nfw"))) == len(segs) + 1
+        r.close()
+
+    def test_prune_drops_fully_flushed_segments(self, tmp_path):
+        w = StagingWAL(tmp_path / "w", segment_bytes=4096)
+        for seq in range(1, 40):
+            w.append_batch(Batch(seq, seq, {f"k{seq}": bytes(200)}))
+        n_before = len(list((tmp_path / "w").glob("wal-*.nfw")))
+        assert n_before >= 2
+        w.mark(39, 39)
+        assert w.prune() > 0
+        assert len(list((tmp_path / "w").glob("wal-*.nfw"))) < n_before
+        w.close()
+        # pruning must not break recovery
+        r = StagingWAL(tmp_path / "w")
+        assert r.pending == []
+        r.close()
+
+    @staticmethod
+    def _write_then_close(tmp_path, n=4):
+        w = StagingWAL(tmp_path / "w")
+        for seq in range(1, n + 1):
+            w.append_batch(Batch(seq, seq, {f"k{seq}": bytes(range(64))}))
+        w.close()
+        return sorted((tmp_path / "w").glob("wal-*.nfw"))[-1]
+
+    def test_torn_tail_of_newest_segment_is_truncated(self, tmp_path):
+        seg = self._write_then_close(tmp_path)
+        clean = seg.read_bytes()
+        # a torn frame: full header promising more body than exists
+        seg.write_bytes(clean + HEADER.pack(WB_BATCH, 500, 0) + b"par")
+        r = StagingWAL(tmp_path / "w")
+        assert r.torn_tail_dropped == 1
+        assert [b.seq for b in r.pending] == [1, 2, 3, 4]
+        r.close()
+        # ... and the truncation is IN PLACE: the tail is gone on disk
+        assert seg.read_bytes() == clean
+
+    def test_torn_header_of_newest_segment_is_truncated(self, tmp_path):
+        seg = self._write_then_close(tmp_path)
+        seg.write_bytes(seg.read_bytes() + b"\x00\x02\x00")
+        r = StagingWAL(tmp_path / "w")
+        assert r.torn_tail_dropped == 1
+        assert len(r.pending) == 4
+        r.close()
+
+    def test_torn_record_in_closed_segment_fails_closed(self, tmp_path):
+        w = StagingWAL(tmp_path / "w", segment_bytes=4096)
+        for seq in range(1, 40):
+            w.append_batch(Batch(seq, seq, {f"k{seq}": bytes(200)}))
+        w.close()
+        oldest = sorted((tmp_path / "w").glob("wal-*.nfw"))[0]
+        oldest.write_bytes(oldest.read_bytes()[:-7])
+        with pytest.raises(WALError):
+            StagingWAL(tmp_path / "w", segment_bytes=4096)
+
+    def test_bit_flip_in_body_fails_crc(self, tmp_path):
+        seg = self._write_then_close(tmp_path)
+        data = bytearray(seg.read_bytes())
+        # flip one bit inside the first record's body
+        data[len(WAL_MAGIC) + HEADER.size + 3] ^= 0x10
+        seg.write_bytes(bytes(data))
+        with pytest.raises(WALError):
+            StagingWAL(tmp_path / "w")
+
+    def test_unknown_record_type_fails_closed(self, tmp_path):
+        seg = self._write_then_close(tmp_path)
+        seg.write_bytes(seg.read_bytes() + HEADER.pack(99, 0, zlib.crc32(b"")))
+        with pytest.raises(WALError):
+            StagingWAL(tmp_path / "w")
+
+    def test_oversize_length_is_corruption_not_allocation(self, tmp_path):
+        seg = self._write_then_close(tmp_path)
+        seg.write_bytes(
+            seg.read_bytes() + HEADER.pack(WB_BATCH, MAX_RECORD_SIZE + 1, 0))
+        with pytest.raises(WALError):
+            StagingWAL(tmp_path / "w")
+
+    def test_bad_magic_fails_closed(self, tmp_path):
+        seg = self._write_then_close(tmp_path)
+        data = bytearray(seg.read_bytes())
+        data[0] ^= 0xFF
+        seg.write_bytes(bytes(data))
+        with pytest.raises(WALError):
+            StagingWAL(tmp_path / "w")
+
+    def test_empty_directory_is_a_fresh_wal(self, tmp_path):
+        w = StagingWAL(tmp_path / "fresh")
+        assert w.pending == [] and w.flushed_seq == 0
+        w.close()
+
+
+# -------------------------------------------------------------- pipeline
+class TestPipeline:
+    def test_flush_watermark_and_lag(self, tmp_path):
+        store = FlakyStore()
+        p = _pipeline(store, tmp_path / "w")
+        try:
+            p.enqueue(5, {"a": b"1", "b": b"2"})
+            assert _wait(lambda: store.data.get("a") == b"1"
+                         and store.data.get("b") == b"2")
+            assert store.data["__wb__:t"] == b"1:5"
+            p.note_tick(9)
+            p.pump()
+            assert p.queue_depth() == 0 and p.lag_ticks() == 0
+            assert not p.degraded()
+            assert p.flushes_total == 1 and p.entries_total == 2
+        finally:
+            p.close()
+
+    def test_empty_enqueue_is_a_noop(self, tmp_path):
+        p = _pipeline(FlakyStore(), tmp_path / "w")
+        try:
+            assert p.enqueue(1, {}) == 0
+            assert p.queue_depth() == 0
+        finally:
+            p.close()
+
+    def test_retry_degraded_then_heal(self, tmp_path):
+        store = FlakyStore()
+        store.fail = True
+        p = _pipeline(store, tmp_path / "w")
+        try:
+            p.enqueue(1, {"a": b"v1"})
+            assert _wait(lambda: p.retries_total >= 3)
+            assert p.degraded() and p.queue_depth() == 1
+            store.fail = False
+            assert _wait(lambda: store.data.get("a") == b"v1")
+            assert _wait(lambda: not p.degraded())
+            assert p.lag_ticks() == 0 or p.queue_depth() == 0
+        finally:
+            p.close()
+
+    def test_tombstone_flushes_as_delete(self, tmp_path):
+        store = FlakyStore()
+        store.data["a"] = b"old"
+        p = _pipeline(store, tmp_path / "w")
+        try:
+            p.enqueue(1, {"a": None})
+            assert _wait(lambda: "a" not in store.data)
+        finally:
+            p.close()
+
+    def test_pending_and_discard_read_your_writes(self, tmp_path):
+        store = FlakyStore()
+        store.fail = True  # hold everything in the queue
+        p = _pipeline(store, tmp_path / "w")
+        try:
+            p.enqueue(1, {"a": b"v1"})
+            p.enqueue(2, {"a": b"v2", "b": None})
+            assert p.pending("a") == (True, b"v2")  # newest wins
+            assert p.pending("b") == (True, None)  # queued tombstone
+            assert p.pending("zzz") == (False, None)
+            assert p.discard("a") == 2
+            assert p.pending("a") == (False, None)
+        finally:
+            p.kill()
+
+    def test_bounded_queue_coalesces_not_blocks(self, tmp_path):
+        store = FlakyStore()
+        store.fail = True
+        p = _pipeline(store, tmp_path / "w", max_queue_batches=4)
+        try:
+            for t in range(1, 41):
+                p.enqueue(t, {f"k{t % 6}": f"v{t}".encode(), "hot": b"%d" % t})
+            # RAM bounded: depth never exceeds the cap + the in-flight slot
+            assert p.queue_depth() <= 5
+            assert p.degraded()  # overflow latch
+            # coalescing kept the NEWEST value per key
+            assert p.pending("hot") == (True, b"40")
+            assert p.pending("k4") == (True, b"v40")
+            store.fail = False
+            assert _wait(lambda: p.queue_depth() == 0, timeout=10)
+            assert store.data["hot"] == b"40"
+            assert store.data["k3"] == b"v39"
+            p.pump()  # overflow latch clears once the queue drained
+            assert not p.degraded()
+        finally:
+            p.close()
+
+    def test_kill_under_write_recovers_from_wal(self, tmp_path):
+        store = FlakyStore()
+        store.fail = True
+        p = _pipeline(store, tmp_path / "w")
+        p.enqueue(1, {"a": b"v1"})
+        p.enqueue(2, {"a": b"v2", "b": b"x"})
+        p.kill()  # no drain, no marks — the crash case
+        assert store.data == {}
+
+        healed = FlakyStore()
+        p2 = _pipeline(healed, tmp_path / "w")
+        try:
+            assert p2.recovered_batches == 2
+            assert _wait(lambda: healed.data.get("a") == b"v2"
+                         and healed.data.get("b") == b"x")
+            assert healed.data["__wb__:t"] == b"2:2"
+        finally:
+            p2.close()
+
+    def test_reflush_after_lost_mark_is_idempotent(self, tmp_path):
+        store = FlakyStore()
+        p = _pipeline(store, tmp_path / "w")
+        p.enqueue(1, {"a": b"v1"})
+        assert _wait(lambda: store.data.get("a") == b"v1")
+        # kill BEFORE pump() could persist the flush mark: the batch is
+        # flushed in the store but unmarked in the WAL
+        p.kill()
+        p2 = _pipeline(store, tmp_path / "w")
+        try:
+            assert p2.recovered_batches == 1  # at-least-once delivery...
+            assert _wait(lambda: store.data.get("__wb__:t") == b"1:1")
+            assert store.data["a"] == b"v1"  # ...exactly-once effect
+        finally:
+            p2.close()
+
+    def test_barrier_syncs_and_drain_reports(self, tmp_path):
+        store = FlakyStore()
+        p = _pipeline(store, tmp_path / "w")
+        try:
+            p.enqueue(3, {"a": b"v"})
+            p.barrier(3)
+            assert p.drain(timeout=5.0)
+            assert store.data.get("a") == b"v"
+        finally:
+            p.close()
+
+    def test_store_calls_never_on_caller_thread(self, tmp_path):
+        import threading
+
+        store = FlakyStore()
+        p = _pipeline(store, tmp_path / "w")
+        try:
+            p.enqueue(1, {"a": b"v"})
+            assert _wait(lambda: p.flushes_total >= 1)
+            assert p.store_threads
+            assert threading.get_ident() not in p.store_threads
+        finally:
+            p.close()
+
+    def test_seq_and_watermark_have_no_wall_clock(self, tmp_path):
+        """Batch identity is (seq, tick) — rebuilding the same enqueue
+        sequence yields byte-identical WAL batch frames, which is what
+        makes recovery flushes reproducible."""
+        frames = []
+        for d in ("w1", "w2"):
+            store = FlakyStore()
+            store.fail = True
+            p = _pipeline(store, tmp_path / d)
+            p.enqueue(7, {"a": b"x"})
+            p.enqueue(8, {"b": b"y"})
+            p.kill()
+            seg = sorted((tmp_path / d).glob("wal-*.nfw"))[0]
+            frames.append(seg.read_bytes())
+        assert frames[0] == frames[1]
+
+
+# -------------------------------------------------- agent routing
+class _Held(StoreBackend):
+    def __init__(self, kv):
+        self.inner = KVBackend(kv)
+        self.fail = False
+
+    def write(self, key, blob):
+        if self.fail:
+            raise IOError("store down")
+        self.inner.write(key, blob)
+
+    def delete(self, key):
+        if self.fail:
+            raise IOError("store down")
+        self.inner.delete(key)
+
+
+def _player_world():
+    from noahgameframe_tpu.game.world import GameWorld, WorldConfig
+
+    return GameWorld(WorldConfig(
+        npc_capacity=8, player_capacity=4, seed=3,
+        combat=False, movement=False, regen=False, middleware=False,
+    )).start()
+
+
+class TestAgentRouting:
+    @pytest.fixture()
+    def rig(self, tmp_path):
+        from noahgameframe_tpu.core.datatypes import Guid
+        from noahgameframe_tpu.persist.agent import PlayerDataAgent
+
+        w = _player_world()
+        kv = MemoryKV()
+        agent = PlayerDataAgent(kv).bind(w.kernel)
+        store = _Held(kv)
+        store.fail = True  # outage from the start
+        agent.pipeline = _pipeline(store, tmp_path / "w")
+        guid = w.kernel.create_object(
+            "Player", {"Name": "Hero", "Account": "acct", "Gold": 7},
+            guid=Guid(9, 500),
+        )
+        yield w, kv, agent, store, guid
+        agent.pipeline.close()
+
+    def test_save_during_outage_is_queued_not_lost(self, rig):
+        w, kv, agent, store, guid = rig
+        assert agent.save(guid)
+        key = agent._key_of(guid)
+        assert kv.get(key) is None  # store never reached
+        found, blob = agent.pipeline.pending(key)
+        assert found and blob
+        # destroy-then-heal: the queued blob survives to the store
+        store.fail = False
+        assert _wait(lambda: kv.get(key) is not None)
+
+    def test_load_prefers_queued_blob_over_stale_store(self, rig):
+        w, kv, agent, store, guid = rig
+        k = w.kernel
+        key = agent._key_of(guid)
+        kv.set(key, b"")  # stale garbage the load must NOT fall back to
+        k.set_property(guid, "Gold", 1234)
+        agent.save(guid)
+        k.set_property(guid, "Gold", 0)
+        assert agent.load(guid)
+        assert int(k.get_property(guid, "Gold")) == 1234
+
+    def test_delete_tombstone_beats_queued_save(self, rig):
+        w, kv, agent, store, guid = rig
+        agent.save(guid)
+        assert agent.delete("acct:Hero")
+        key = agent._key_of(guid)
+        assert agent.pipeline.pending(key) == (True, None)
+        assert not agent.exists("acct:Hero")
+        assert not agent.load(guid)  # a queued tombstone means "no blob"
+        store.fail = False
+        assert _wait(lambda: agent.pipeline.queue_depth() == 0)
+        assert kv.get(key) is None  # no resurrection after the flush
+
+
+# ----------------------------------------------------------- e2e
+def test_kill_under_write_e2e(tmp_path):
+    """The acceptance scenario: a game role persisting through a faulted
+    store is killed mid-outage and revived from the durable (checkpoint,
+    WAL) pair; the world must match the fault-free control bit-for-bit,
+    the store must converge to the world's own snapshots, and the tick
+    loop must never have blocked on the store."""
+    smoke = _load_script("persist_smoke")
+    checks = smoke.run(tmp_path, seed=7)
+    failed = [name for name, ok in checks.items() if not ok]
+    assert not failed, f"persist smoke checks failed: {failed}"
