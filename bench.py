@@ -462,6 +462,10 @@ def run_served(args) -> dict:
             "device": str(dev),
             "platform": dev.platform,
             "binning": binning_mode(),
+            # per-stage frame waterfall (ISSUE 7): p50/p95/mean ms per
+            # pipeline stage from the role's StageClock, plus the last
+            # frame's exact breakdown and trace-sidecar counters
+            "pipeline": role.pipeline_stats(),
         },
     }
 

@@ -14,8 +14,10 @@ cluster, and usable as a bot/load-test client against a real deployment.
 from __future__ import annotations
 
 import dataclasses
+import time as _time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..telemetry.pipeline import TraceError, decode_trace, encode_trace
 from ..net.defines import EventCode, MsgID
 from ..net.transport import EV_CONNECTED, EV_DISCONNECTED, EV_MSG, PyNetClient
 from ..net.wire import (
@@ -124,6 +126,8 @@ class GameClient:
         self.slg_acks: list = []
         self.pvp_matches: list = []   # AckPVPApplyMatch (room assignments)
         self.pvp_ectypes: list = []   # AckCreatePVPEctype (instance grants)
+        # frame observatory: received trace sidecars (bounded), acked back
+        self.traces: List[dict] = []
         self._handlers: Dict[int, Callable[[MsgBase], None]] = {}
         self._install()
 
@@ -160,6 +164,7 @@ class GameClient:
         h[int(MsgID.ACK_MOVE)] = self._on_move
         h[int(MsgID.ACK_CHAT)] = self._on_chat
         h[int(MsgID.ACK_SKILL_OBJECTX)] = self._on_skill
+        h[int(MsgID.FRAME_TRACE)] = self._on_frame_trace
         # middleware acks: stored raw-decoded for callers to inspect
         def keep(store: list, cls):
             def on(base: MsgBase) -> None:
@@ -220,6 +225,31 @@ class GameClient:
         return self._conn is not None and self._conn.send_msg(
             int(msg_id), wrap(msg)
         )
+
+    def _on_frame_trace(self, base: MsgBase) -> None:
+        """Frame-observatory sidecar: stamp receipt, keep a bounded local
+        log, and echo the header back — the ack rides the normal
+        client→proxy→game path so the game measures a true round trip."""
+        try:
+            ctx = decode_trace(base.msg_data)
+        except TraceError:
+            return
+        ctx.client_recv_ns = _time.perf_counter_ns()
+        self.traces.append({
+            "tick": ctx.tick,
+            "game_id": ctx.game_id,
+            "seq": ctx.seq,
+            "proxy_relay_ms": (
+                (ctx.proxy_out_ns - ctx.proxy_in_ns) / 1e6
+                if ctx.proxy_out_ns and ctx.proxy_in_ns else None
+            ),
+        })
+        del self.traces[:-256]
+        if self._conn is not None:
+            self._conn.send_msg(
+                int(MsgID.FRAME_TRACE_ACK),
+                MsgBase(msg_data=encode_trace(ctx)).encode(),
+            )
 
     # ------------------------------------------------------------- login flow
     def login(self) -> None:

@@ -253,6 +253,12 @@ class Kernel(Module):
         # optional telemetry.SpanTracer for host-side tick stage spans
         # (dispatch / summary fetch / post-tick fan-out); None = no cost
         self.tracer = None
+        # honest per-stage timing (NF_STAGE_TIMING=1, set by GameRole /
+        # telemetry/pipeline.stage_timing_enabled): block after dispatch
+        # so the kernel.dispatch span measures device time, not async
+        # enqueue latency.  Never on by default — it serializes the
+        # device queue and kills dispatch/fetch overlap.
+        self.stage_timing = False
 
     # -- build --------------------------------------------------------------
 
@@ -515,6 +521,8 @@ class Kernel(Module):
         self._ensure_aux()
         with self._span("kernel.dispatch"):
             self.state, raw = self._jit_step(self.state)
+            if self.stage_timing:
+                jax.block_until_ready((self.state, raw))
         self.tick_count += 1
         out = TickOutputs(
             fired=raw["fired"],

@@ -139,6 +139,12 @@ class MsgID(enum.IntEnum):
     # serialized-player companion to REQ_SWITCH_SERVER (re-home without
     # a shared database; game -> world -> target game)
     SWITCH_SERVER_DATA = 8003
+    # frame observatory (ISSUE 7): sampled trace context riding the
+    # served path game -> proxy -> client, acked back client -> proxy ->
+    # game.  Pure observability — both ids are excluded from the flight
+    # recorder journal so replays stay bit-identical with tracing on.
+    FRAME_TRACE = 8004
+    FRAME_TRACE_ACK = 8005
 
     # in-game actions
     REQ_MOVE = 1230
@@ -204,6 +210,11 @@ class MsgID(enum.IntEnum):
     REQ_CREATE_ITEM = 20102
     REQ_BUILD_OPERATE = 20103
 
+
+#: Frame-observatory sidecar opcodes: excluded from the flight-recorder
+#: journal (net/roles/game.py ``_journal_tap``) so a journaled run
+#: replays bit-identically whether tracing was on or off.
+TRACE_MSG_IDS = frozenset({int(MsgID.FRAME_TRACE), int(MsgID.FRAME_TRACE_ACK)})
 
 #: Reference cadence constants (NFINetClientModule.hpp:349,397)
 KEEPALIVE_SECONDS = 10.0

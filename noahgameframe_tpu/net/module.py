@@ -41,6 +41,11 @@ class NetCounters:
         self.in_bytes: Dict[int, int] = {}
         self.out_msgs: Dict[int, int] = {}
         self.out_bytes: Dict[int, int] = {}
+        # forward-relay latency per opcode (proxy _transpond): total ns
+        # from dispatch arrival to fan-out complete, sampled lazily by
+        # telemetry's nf_relay_msgs_total / nf_relay_seconds_total
+        self.relay_msgs: Dict[int, int] = {}
+        self.relay_ns: Dict[int, int] = {}
 
     def count_in(self, msg_id: int, nbytes: int) -> None:
         self.in_msgs[msg_id] = self.in_msgs.get(msg_id, 0) + 1
@@ -49,6 +54,10 @@ class NetCounters:
     def count_out(self, msg_id: int, nbytes: int) -> None:
         self.out_msgs[msg_id] = self.out_msgs.get(msg_id, 0) + 1
         self.out_bytes[msg_id] = self.out_bytes.get(msg_id, 0) + nbytes
+
+    def count_relay(self, msg_id: int, dur_ns: int) -> None:
+        self.relay_msgs[msg_id] = self.relay_msgs.get(msg_id, 0) + 1
+        self.relay_ns[msg_id] = self.relay_ns.get(msg_id, 0) + dur_ns
 
 
 class _Dispatch:

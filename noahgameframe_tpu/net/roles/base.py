@@ -176,13 +176,18 @@ class ServerRole:
             server_state=self.state,
             server_type=self.server_type,
         )
+        ext = ServerInfoExt()
+        # clock-sync echo (ISSUE 7): the sender's monotonic stamp lets
+        # the master estimate per-role clock offsets NTP-style (sliding
+        # min of recv - sent over the heartbeat stream)
+        ext.key.append(b"mono_ns")
+        ext.value.append(str(_time.perf_counter_ns()).encode())
         if self.metrics.frames:
             p = self.metrics.percentiles()
-            ext = ServerInfoExt()
             for k in ("p50_ms", "p95_ms", "p99_ms"):
                 ext.key.append(f"frame_{k}".encode())
                 ext.value.append(f"{p[k]:.3f}".encode())
-            r.server_info_list_ext = ext
+        r.server_info_list_ext = ext
         return r
 
     def report_list(self) -> ServerInfoReportList:

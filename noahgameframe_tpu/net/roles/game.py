@@ -19,12 +19,22 @@ of one callback per write.
 from __future__ import annotations
 
 import dataclasses
+import json as _json
 import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ...core.datatypes import Bank, DataType, Guid
+from ...telemetry.pipeline import (
+    StageClock,
+    TraceContext,
+    TraceError,
+    decode_trace,
+    encode_trace,
+    stage_timing_enabled,
+    trace_sample_n,
+)
 from ...core.store import RecordOp
 from ...game.world import GameWorld, WorldConfig
 from ...kernel.kernel import (
@@ -41,7 +51,7 @@ from ...persist.codec import (
     serialize_records,
     snapshot_object,
 )
-from ..defines import EventCode, MsgID, ServerState, ServerType
+from ..defines import TRACE_MSG_IDS, EventCode, MsgID, ServerState, ServerType
 from ..transport import EV_DISCONNECTED
 from ..wire import (
     AckEventResult,
@@ -425,6 +435,29 @@ class GameRole(ServerRole):
                             self._persist_rec_diff,
                         )
                 self.kernel.subscribe_record_host(self._persist_rec_host)
+        # frame observatory (ISSUE 7): per-frame exclusive stage clock
+        # over the served path (tick → harvest → interest → encode →
+        # send) + sampled wire trace state.  NF_STAGE_TIMING=1 flips the
+        # kernel into honest per-stage device timing; NF_TRACE_SAMPLE=N
+        # traces 1-in-N sessions (0 disables).
+        self.stage_clock = StageClock(self.telemetry.registry)
+        self._stage_timing = stage_timing_enabled()
+        self.kernel.stage_timing = self._stage_timing
+        self._trace_sample = trace_sample_n()
+        self._trace_seq = 0
+        self._trace_pending: Dict[int, Tuple[int, int]] = {}
+        self.trace_sent = 0
+        self.trace_acked = 0
+        self.last_trace: Optional[dict] = None
+        treg = self.telemetry.registry
+        self._trace_rtt_hist = treg.histogram(
+            "nf_trace_rtt_seconds",
+            "frame-trace round trip: encode → client ack received",
+        )
+        self._trace_relay_hist = treg.histogram(
+            "nf_trace_proxy_relay_seconds",
+            "proxy in→out relay of sampled frame traces (proxy clock)",
+        )
 
     def _persist_prop_change(self, cname: str, pname: str, rows) -> None:
         self._persist_dirty.update(int(r) for r in rows)
@@ -464,7 +497,11 @@ class GameRole(ServerRole):
     def _journal_tap(self, source: int):
         def tap(ev) -> None:
             j = self.journal
-            if j is not None:
+            # frame-trace sidecars (TRACE_MSG_IDS) are pure observability
+            # and never touch device state: journaling them would make
+            # the recorded input stream — and thus replay byte-identity —
+            # depend on whether tracing was sampled that run
+            if j is not None and ev.msg_id not in TRACE_MSG_IDS:
                 j.event(source, ev.kind, ev.conn_id, ev.msg_id, ev.body)
         return tap
 
@@ -500,11 +537,11 @@ class GameRole(ServerRole):
             self.state = (int(ServerState.BUSY) if self.persist.degraded()
                           else int(ServerState.NORMAL))
         r = super().report()
+        ext = r.server_info_list_ext
+        if ext is None:
+            ext = ServerInfoExt()
+            r.server_info_list_ext = ext
         if self.persist is not None:
-            ext = r.server_info_list_ext
-            if ext is None:
-                ext = ServerInfoExt()
-                r.server_info_list_ext = ext
             for k, v in (
                 ("persist_lag_ticks", self.persist.lag_ticks()),
                 ("persist_queue_depth", self.persist.queue_depth()),
@@ -512,7 +549,37 @@ class GameRole(ServerRole):
             ):
                 ext.key.append(k.encode())
                 ext.value.append(str(v).encode())
+        # frame-pipeline attribution blob: the master's /pipeline route
+        # parses this into the cluster-wide stage waterfall
+        ext.key.append(b"pipeline")
+        ext.value.append(_json.dumps(self.pipeline_stats()).encode())
         return r
+
+    def pipeline_stats(self) -> dict:
+        """Stage waterfall + wire-trace summary for /pipeline and bench."""
+        sc = self.stage_clock
+        out = {
+            "frames": sc.frames,
+            "last_tick": sc.last_tick,
+            "last_wall_ms": round(sc.last_wall_ns / 1e6, 4),
+            "last_ms": {k: round(v / 1e6, 4) for k, v in sc.last.items()},
+            "stages": sc.stats(),
+            "trace": {
+                "sample": self._trace_sample,
+                "sent": self.trace_sent,
+                "acked": self.trace_acked,
+                "pending": len(self._trace_pending),
+            },
+        }
+        if self._trace_rtt_hist.count:
+            out["trace"]["rtt_p50_ms"] = round(
+                self._trace_rtt_hist.percentile(50.0) * 1e3, 4)
+            out["trace"]["rtt_p95_ms"] = round(
+                self._trace_rtt_hist.percentile(95.0) * 1e3, 4)
+        if self._trace_relay_hist.count:
+            out["trace"]["relay_p50_ms"] = round(
+                self._trace_relay_hist.percentile(50.0) * 1e3, 4)
+        return out
 
     def _install(self) -> None:
         s = self.server
@@ -548,6 +615,7 @@ class GameRole(ServerRole):
         s.on(MsgID.REQ_UP_BUILD_LVL, self._on_slg_upgrade)
         s.on(MsgID.REQ_CREATE_ITEM, self._on_slg_create_item)
         s.on(MsgID.REQ_BUILD_OPERATE, self._on_slg_operate)
+        s.on(MsgID.FRAME_TRACE_ACK, self._on_frame_trace_ack)
         s.on_socket_event(self._on_socket)
 
     def cur_count(self) -> int:
@@ -556,9 +624,72 @@ class GameRole(ServerRole):
     # ------------------------------------------------------------ sending
     def _send_to(self, idents: Sequence[Ident], conn_id: int, msg_id: int,
                  msg: Message) -> None:
+        # "send" stage = envelope encode + transport write; add_ns keeps
+        # it exclusive of whichever stage (interest/encode) called us
+        t0 = _time.perf_counter_ns()
         self.server.send_raw(
             conn_id, int(msg_id), wrap(msg, clients=list(idents))
         )
+        self.stage_clock.add_ns("send", _time.perf_counter_ns() - t0)
+
+    # ------------------------------------------------------ wire tracing
+    def _emit_frame_traces(self) -> None:
+        """End of a flushed frame: send the sampled sessions a FRAME_TRACE
+        sidecar.  TCP ordering puts it *behind* the frame's sync traffic
+        on the same connection, so the acked round trip upper-bounds the
+        frame's true delivery latency."""
+        n = self._trace_sample
+        for sess in self.sessions.values():
+            if sess.ident.index % n:
+                continue
+            self._trace_seq = (self._trace_seq + 1) & 0xFFFFFFFF
+            seq = self._trace_seq
+            t_enc = _time.perf_counter_ns()
+            ctx = TraceContext(tick=self.kernel.tick_count,
+                               game_id=self.config.server_id,
+                               seq=seq, t_encode_ns=t_enc)
+            self._trace_pending[seq] = (self.kernel.tick_count, t_enc)
+            while len(self._trace_pending) > 4096:  # lost acks: drop oldest
+                self._trace_pending.pop(next(iter(self._trace_pending)))
+            base = MsgBase(player_id=sess.ident,
+                           msg_data=encode_trace(ctx),
+                           player_client_list=[sess.ident])
+            self.server.send_raw(
+                sess.conn_id, int(MsgID.FRAME_TRACE), base.encode()
+            )
+            self.trace_sent += 1
+
+    def _on_frame_trace_ack(self, _conn_id: int, _msg_id: int,
+                            body: bytes) -> None:
+        """Client echoed the stamped header back: close the loop with
+        same-clock deltas only — RTT on the game clock, relay on the
+        proxy clock.  Never touches device state (replay identity)."""
+        now_ns = _time.perf_counter_ns()
+        base = MsgBase.decode(body)
+        try:
+            ctx = decode_trace(base.msg_data)
+        except TraceError:
+            return
+        if ctx.game_id != self.config.server_id:
+            return
+        pend = self._trace_pending.pop(ctx.seq, None)
+        if pend is None:
+            return  # duplicate or aged out
+        tick, t_enc = pend
+        rtt_s = (now_ns - t_enc) / 1e9
+        self._trace_rtt_hist.observe(rtt_s)
+        relay_ms = None
+        if ctx.proxy_out_ns and ctx.proxy_in_ns:
+            relay_s = (ctx.proxy_out_ns - ctx.proxy_in_ns) / 1e9
+            self._trace_relay_hist.observe(relay_s)
+            relay_ms = round(relay_s * 1e3, 4)
+        self.trace_acked += 1
+        self.last_trace = {
+            "tick": tick,
+            "seq": ctx.seq,
+            "rtt_ms": round(rtt_s * 1e3, 4),
+            "proxy_relay_ms": relay_ms,
+        }
 
     def _send_to_session(self, sess: Session, msg_id: int, msg: Message) -> None:
         self._send_to([sess.ident], sess.conn_id, msg_id, msg)
@@ -1492,9 +1623,18 @@ class GameRole(ServerRole):
         now = _time.monotonic() if now is None else now
         super().execute(now)
         pm = self.game_world.pm
-        if now - self._last_tick >= self.game_world.config.dt:
+        sc = self.stage_clock
+        tick_due = now - self._last_tick >= self.game_world.config.dt
+        # one stage-clock frame spans tick + flush of this pump pass; a
+        # flush can also fire alone (host writes between ticks)
+        framed = tick_due or bool(self._changed or self._rec_changed
+                                  or self._interest_dirty)
+        if framed:
+            sc.frame_begin(self.kernel.tick_count)
+        flushed = False
+        if tick_due:
             self._last_tick = now
-            with self.telemetry.tracer.span("game.tick"):
+            with self.telemetry.tracer.span("game.tick"), sc.stage("tick"):
                 t0 = _time.perf_counter()
                 for m in pm.modules.values():
                     if m is not self.kernel:
@@ -1522,10 +1662,15 @@ class GameRole(ServerRole):
             with self.telemetry.tracer.span("game.flush"):
                 if self.sessions:
                     self._flush_changes()
+                    flushed = True
                 else:
                     self._changed.clear()
                     self._rec_changed.clear()
                     self._interest_dirty.clear()
+        if framed:
+            sc.frame_end()
+            if flushed and self._trace_sample > 0:
+                self._emit_frame_traces()
         # periodic autosave: device-side deaths free the row before any
         # BEFORE_DESTROY hook can run, so the blob must already be fresh
         if (self.data_agent is not None
@@ -1907,8 +2052,10 @@ class GameRole(ServerRole):
         messages → proxy (client lists in the envelope).  All device reads
         are row-subset gathers done once per class per frame."""
         k = self.kernel
-        changed, self._changed = self._changed, {}
-        player_idx = self._build_player_index()
+        sc = self.stage_clock
+        with sc.stage("harvest"):
+            changed, self._changed = self._changed, {}
+            player_idx = self._build_player_index()
         # interest lane: Position diffs of synced classes leave as
         # per-session interest-filtered streams when a radius is set.
         # The pipeline only runs when something that can change a visible
@@ -1917,118 +2064,127 @@ class GameRole(ServerRole):
         # in the class (the dirty marks) — so an idle world pays nothing.
         self._obs_cache = None  # one _observer_arrays() per flush
         if self.interest_radius is not None:
-            obs_sig = tuple(sorted(
-                (key, s.guid)
-                for key, s in self.sessions.items()
-                if s.guid is not None and s.guid in self.kernel.store.guid_map
-            ))
-            obs_moved = obs_sig != self._last_obs_sig
-            self._last_obs_sig = obs_sig
+            with sc.stage("interest"):
+                obs_sig = tuple(sorted(
+                    (key, s.guid)
+                    for key, s in self.sessions.items()
+                    if s.guid is not None
+                    and s.guid in self.kernel.store.guid_map
+                ))
+                obs_moved = obs_sig != self._last_obs_sig
+                self._last_obs_sig = obs_sig
 
-            def zone_changed(cn: str) -> bool:
-                # visible sets mask on scene+group too — a swap with no
-                # Position diff still changes who sees whom.  These keys
-                # are NOT popped: zone props also ride the normal
-                # broadcast sync.
-                return ((cn, "SceneID") in changed
-                        or (cn, "GroupID") in changed)
+                def zone_changed(cn: str) -> bool:
+                    # visible sets mask on scene+group too — a swap with
+                    # no Position diff still changes who sees whom.
+                    # These keys are NOT popped: zone props also ride
+                    # the normal broadcast sync.
+                    return ((cn, "SceneID") in changed
+                            or (cn, "GroupID") in changed)
 
-            player_moved = ("Player", "Position") in changed \
-                or zone_changed("Player")
-            for cname in self.sync_classes:
-                # only claim the diff when the class can ride the interest
-                # lane — non-spatial classes (no SceneID/GroupID) fall
-                # through to the broadcast lanes below
-                if not self._interest_ok(cname):
-                    continue
-                pos_changed = changed.pop((cname, "Position"), None) is not None
-                if (pos_changed or player_moved or obs_moved
-                        or zone_changed(cname)
-                        or cname in self._interest_dirty):
-                    self._interest_dirty.discard(cname)
-                    self._send_interest_pos(cname)
-        # columnar fast lane: large public scalar/vector diffs leave as
-        # packed-array batches (100k movers = a handful of messages, not
-        # 100k python serializations)
-        if self.batch_sync_min > 0:
-            for key in [
-                kk for kk, rows in changed.items()
-                if rows.size >= self.batch_sync_min
-            ]:
-                cname, pname = key
-                p = k.store.spec(cname).slot(pname).prop
-                if p.public and p.type in (
-                    DataType.INT, DataType.FLOAT,
-                    DataType.VECTOR2, DataType.VECTOR3,
-                ):
-                    self._send_batch_property(
-                        cname, pname, changed.pop(key), player_idx
+                player_moved = ("Player", "Position") in changed \
+                    or zone_changed("Player")
+                for cname in self.sync_classes:
+                    # only claim the diff when the class can ride the
+                    # interest lane — non-spatial classes (no SceneID/
+                    # GroupID) fall through to the broadcast lanes below
+                    if not self._interest_ok(cname):
+                        continue
+                    pos_changed = changed.pop(
+                        (cname, "Position"), None) is not None
+                    if (pos_changed or player_moved or obs_moved
+                            or zone_changed(cname)
+                            or cname in self._interest_dirty):
+                        self._interest_dirty.discard(cname)
+                        self._send_interest_pos(cname)
+        with sc.stage("encode"):
+            # columnar fast lane: large public scalar/vector diffs leave
+            # as packed-array batches (100k movers = a handful of
+            # messages, not 100k python serializations)
+            if self.batch_sync_min > 0:
+                for key in [
+                    kk for kk, rows in changed.items()
+                    if rows.size >= self.batch_sync_min
+                ]:
+                    cname, pname = key
+                    p = k.store.spec(cname).slot(pname).prop
+                    if p.public and p.type in (
+                        DataType.INT, DataType.FLOAT,
+                        DataType.VECTOR2, DataType.VECTOR3,
+                    ):
+                        self._send_batch_property(
+                            cname, pname, changed.pop(key), player_idx
+                        )
+            # regroup per (class, row): one message per entity per kind
+            per_entity: Dict[Tuple[str, int], List[str]] = {}
+            for (cname, pname), rows in changed.items():
+                for row in rows:
+                    per_entity.setdefault((cname, int(row)), []).append(pname)
+            rows_by_class: Dict[str, np.ndarray] = {}
+            for cname, row in per_entity:
+                rows_by_class.setdefault(cname, []).append(row)
+            pos_by_class: Dict[str, Dict[int, int]] = {}
+            cells_by_class: Dict[str, np.ndarray] = {}
+            vis_by_class: Dict[str, Dict[int, List[Guid]]] = {}
+            for cname, rws in list(rows_by_class.items()):
+                arr = np.asarray(sorted(set(rws)), np.int64)
+                rows_by_class[cname] = arr
+                pos_by_class[cname] = {int(r): i for i, r in enumerate(arr)}
+                cells_by_class[cname] = self._rows_cells(cname, arr)
+                if (self.interest_radius is not None
+                        and self._interest_ok(cname)):
+                    # device visibility query: interest work even though
+                    # it feeds the encode loop below
+                    with sc.stage("interest"):
+                        vis_by_class[cname] = self._interest_targets(
+                            cname, arr)
+            sub_cache: Dict[Tuple[str, str], np.ndarray] = {}
+
+            def bank_vals(cname: str, bank: Bank) -> np.ndarray:
+                """Row-subset bank fetch, indexed by LOCAL position."""
+                key = (cname, bank.value)
+                if key not in sub_cache:
+                    cs = k.state.classes[cname]
+                    sub_cache[key] = gather_rows(
+                        getattr(cs, bank.value), rows_by_class[cname]
                     )
-        # regroup per (class, row) so each entity sends one message per kind
-        per_entity: Dict[Tuple[str, int], List[str]] = {}
-        for (cname, pname), rows in changed.items():
-            for row in rows:
-                per_entity.setdefault((cname, int(row)), []).append(pname)
-        rows_by_class: Dict[str, np.ndarray] = {}
-        for cname, row in per_entity:
-            rows_by_class.setdefault(cname, []).append(row)
-        pos_by_class: Dict[str, Dict[int, int]] = {}
-        cells_by_class: Dict[str, np.ndarray] = {}
-        vis_by_class: Dict[str, Dict[int, List[Guid]]] = {}
-        for cname, rws in list(rows_by_class.items()):
-            arr = np.asarray(sorted(set(rws)), np.int64)
-            rows_by_class[cname] = arr
-            pos_by_class[cname] = {int(r): i for i, r in enumerate(arr)}
-            cells_by_class[cname] = self._rows_cells(cname, arr)
-            if self.interest_radius is not None and self._interest_ok(cname):
-                vis_by_class[cname] = self._interest_targets(cname, arr)
-        sub_cache: Dict[Tuple[str, str], np.ndarray] = {}
+                return sub_cache[key]
 
-        def bank_vals(cname: str, bank: Bank) -> np.ndarray:
-            """Row-subset bank fetch, indexed by LOCAL position."""
-            key = (cname, bank.value)
-            if key not in sub_cache:
-                cs = k.state.classes[cname]
-                sub_cache[key] = gather_rows(
-                    getattr(cs, bank.value), rows_by_class[cname]
-                )
-            return sub_cache[key]
-
-        for (cname, row), pnames in per_entity.items():
-            host = k.store._hosts[cname]
-            guid = host.row_guid[row] if row < len(host.row_guid) else None
-            if guid is None:
-                continue  # died since the change was queued
-            spec = k.store.spec(cname)
-            pos = pos_by_class[cname][row]
-            sc, gr = cells_by_class[cname][pos].tolist()
-            # public props broadcast to the (scene, group); private-only
-            # props go to the owner's client alone
-            for public in (True, False):
-                sel = [
-                    p for p in pnames
-                    if bool(spec.slot(p).prop.public) is public
-                    and (public or spec.slot(p).prop.private)
-                ]
-                if not sel:
-                    continue
-                if public and cname in vis_by_class:
-                    # interest lane: public to whoever can see you, plus
-                    # always the owner's own client
-                    targets = list(vis_by_class[cname].get(row, []))
-                    if cname == "Player" and guid not in targets:
-                        targets.append(guid)
-                else:
-                    targets = self._targets_from_index(
-                        player_idx, guid, sc, gr, public, cname
+            for (cname, row), pnames in per_entity.items():
+                host = k.store._hosts[cname]
+                guid = host.row_guid[row] if row < len(host.row_guid) else None
+                if guid is None:
+                    continue  # died since the change was queued
+                spec = k.store.spec(cname)
+                pos = pos_by_class[cname][row]
+                scn, gr = cells_by_class[cname][pos].tolist()
+                # public props broadcast to the (scene, group); private-
+                # only props go to the owner's client alone
+                for public in (True, False):
+                    sel = [
+                        p for p in pnames
+                        if bool(spec.slot(p).prop.public) is public
+                        and (public or spec.slot(p).prop.private)
+                    ]
+                    if not sel:
+                        continue
+                    if public and cname in vis_by_class:
+                        # interest lane: public to whoever can see you,
+                        # plus always the owner's own client
+                        targets = list(vis_by_class[cname].get(row, []))
+                        if cname == "Player" and guid not in targets:
+                            targets.append(guid)
+                    else:
+                        targets = self._targets_from_index(
+                            player_idx, guid, scn, gr, public, cname
+                        )
+                    if not targets:
+                        continue
+                    self._send_property_msgs(
+                        cname, pos, guid, sel, targets, bank_vals,
+                        forward=(public and cname == "Player"),
                     )
-                if not targets:
-                    continue
-                self._send_property_msgs(
-                    cname, pos, guid, sel, targets, bank_vals,
-                    forward=(public and cname == "Player"),
-                )
-        self._flush_records(player_idx)
+            self._flush_records(player_idx)
 
     def _interest_step(self, cname: str, s_pad: int):
         """Cached per-(class, padded-session-count) jit of the interest

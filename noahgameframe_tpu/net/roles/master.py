@@ -13,8 +13,11 @@ from __future__ import annotations
 
 import dataclasses
 import html
+import json as _json
 import time as _time
 from typing import Dict, Optional
+
+from ...telemetry.pipeline import ClockSync
 
 from ..defines import (
     LEASE_DOWN_SECONDS,
@@ -70,6 +73,10 @@ class MasterRole(ServerRole):
         self.chaos_status = None  # Optional[Callable[[], dict]]
         self.lease_suspect_seconds = lease_suspect_seconds
         self.lease_down_seconds = lease_down_seconds
+        # per-role monotonic clock offsets estimated from the mono_ns
+        # stamp every heartbeat report carries (frame observatory):
+        # offset ≈ sliding min of (master recv − sender stamp)
+        self.clock = ClockSync()
         super().__init__(config, backend=backend)
         reg = self.telemetry.registry
         self._lease_expirations = reg.counter(
@@ -83,6 +90,7 @@ class MasterRole(ServerRole):
         if http_port is not None:
             self.http = HttpServer(config.ip, http_port)
             self.http.route("/json", lambda _p, _q: self.servers_status())
+            self.http.route("/pipeline", lambda _p, _q: self.pipeline_status())
             self.http.route("/", self._index_page)
             # Prometheus exposition rides the same status server
             self.telemetry.mount(self.http)
@@ -127,12 +135,34 @@ class MasterRole(ServerRole):
         prev = by_id.get(r.server_id)
         recovered = prev is not None and prev.lease == LEASE_DOWN
         by_id[r.server_id] = _Registered(r, conn_id, _time.monotonic())
+        # clock-sync echo: every report carries the sender's monotonic
+        # stamp; min-filter (recv - sent) into the per-role offset
+        sent = self._ext_of(r).get("mono_ns")
+        if sent:
+            try:
+                self.clock.update(
+                    f"{self._type_name(int(r.server_type))}{r.server_id}",
+                    int(sent), _time.perf_counter_ns(),
+                )
+            except ValueError:
+                pass  # garbled stamp: skip the sample
         if recovered:
             # a DOWN server reporting again has recovered (restart or
             # healed partition): count it and restore routing
             self._lease_recoveries.inc(role=self._type_name(int(r.server_type)))
             if int(r.server_type) == int(ServerType.WORLD):
                 self._push_world_list()
+
+    @staticmethod
+    def _ext_of(r: ServerInfoReport) -> Dict[str, str]:
+        """The report's ext map as str→str (wire carries bytes)."""
+        ext = r.server_info_list_ext
+        if ext is None or not ext.key:
+            return {}
+        def s(v):
+            return (v.decode("utf-8", "replace")
+                    if isinstance(v, (bytes, bytearray)) else str(v))
+        return {s(k): s(v) for k, v in zip(ext.key, ext.value)}
 
     @staticmethod
     def _type_name(stype: int) -> str:
@@ -246,6 +276,39 @@ class MasterRole(ServerRole):
             except Exception:  # noqa: BLE001 — a dead probe must not kill /json
                 status["chaos"] = {"error": "chaos status unavailable"}
         return status
+
+    def pipeline_status(self) -> dict:
+        """Frame-pipeline waterfall for the whole cluster (/pipeline):
+        per-game stage timings + trace round trips and per-proxy relay
+        percentiles, parsed from the heartbeat ext blobs, alongside the
+        NTP-style per-role clock offsets for multi-process trace merges."""
+        out: Dict[str, object] = {
+            "clock_offsets_ns": self.clock.offsets(),
+            "games": [],
+            "proxies": [],
+        }
+        for stype, bucket in (
+            (int(ServerType.GAME), "games"),
+            (int(ServerType.PROXY), "proxies"),
+        ):
+            for sid, reg in sorted(self.registry.get(stype, {}).items()):
+                ext = self._ext_of(reg.report)
+                entry: Dict[str, object] = {
+                    "server_id": sid,
+                    "lease": reg.lease,
+                }
+                blob = ext.get("pipeline")
+                if blob:
+                    try:
+                        entry["pipeline"] = _json.loads(blob)
+                    except ValueError:
+                        entry["pipeline"] = {"error": "unparseable blob"}
+                for k in ("frame_p50_ms", "frame_p95_ms", "frame_p99_ms",
+                          "relay_p50_ms", "relay_p95_ms", "traces_relayed"):
+                    if k in ext:
+                        entry[k] = ext[k]
+                out[bucket].append(entry)  # type: ignore[union-attr]
+        return out
 
     def _index_page(self, _path: str, _params: Dict[str, str]):
         """Dashboard at "/": serves the standalone monitor page

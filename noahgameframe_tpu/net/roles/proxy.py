@@ -18,9 +18,10 @@ import hmac
 import time as _time
 from typing import Dict, Optional, Tuple
 
+from ...telemetry.pipeline import TraceError, decode_trace, encode_trace
 from ..defines import EventCode, MsgID, ServerState, ServerType
 from ..module import NORMAL, NetClientModule
-from ..transport import EV_DISCONNECTED
+from ..transport import EV_DISCONNECTED, EV_MSG
 from ..wire import (
     AckConnectWorldResult,
     AckEventResult,
@@ -71,7 +72,20 @@ class ProxyRole(ServerRole):
         # sees the control message (reference: gate handles
         # EGMI_REQSWICHSERVER from the game, NFCGSSwichServerModule)
         self.games.on(MsgID.REQ_SWITCH_SERVER, self._on_switch_route)
+        # frame observatory (ISSUE 7): the dispatch tap stamps arrival
+        # time for every game→proxy message, so _transpond can attribute
+        # its relay latency, and FRAME_TRACE sidecars get proxy_in/out
+        # stamps before fan-out (a dedicated handler keeps them off the
+        # blind _transpond path)
+        self.games.dispatch.tap = self._games_tap
+        self.games.on(MsgID.FRAME_TRACE, self._on_frame_trace)
         self.games.on_any(self._transpond)
+        self._relay_arrival_ns = 0
+        self._relay_hist = self.telemetry.registry.histogram(
+            "nf_proxy_relay_seconds",
+            "game→client transpond relay latency (arrival to fan-out done)",
+        )
+        self.traces_relayed = 0
 
     def _install(self) -> None:
         s = self.server
@@ -234,6 +248,40 @@ class ProxyRole(ServerRole):
         if info is not None:
             info["game_id"] = int(req.target_serverid)
 
+    def _games_tap(self, ev) -> None:
+        """Dispatch-tap seam (net/module.py:_Dispatch.tap): stamp arrival
+        time for the message about to be handled.  feed() is synchronous
+        — tap fires, then the handler — so the stamp always belongs to
+        the event the handler sees."""
+        if ev.kind == EV_MSG:
+            self._relay_arrival_ns = _time.perf_counter_ns()
+
+    def _on_frame_trace(self, _sid: int, msg_id: int, body: bytes) -> None:
+        """Stamp the sampled trace sidecar with proxy in/out times and fan
+        it out exactly like _transpond would — re-encoded, since the
+        header mutates in flight."""
+        arrival = self._relay_arrival_ns
+        base = MsgBase.decode(body)
+        try:
+            ctx = decode_trace(base.msg_data)
+        except TraceError:
+            return  # malformed sidecar: drop, never crash the edge
+        targets = base.player_client_list or (
+            [base.player_id] if base.player_id is not None else []
+        )
+        ctx.proxy_in_ns = arrival
+        ctx.proxy_out_ns = _time.perf_counter_ns()
+        base.msg_data = encode_trace(ctx)
+        out = base.encode()
+        for ident in targets:
+            conn_id = self._client_conn.get(_ident_key(ident))
+            if conn_id is not None:
+                self.server.send_raw(conn_id, msg_id, out)
+        self.traces_relayed += 1
+        done = _time.perf_counter_ns()
+        self.games.counters.count_relay(msg_id, done - arrival)
+        self._relay_hist.observe((done - arrival) / 1e9)
+
     def _transpond(self, _sid: int, msg_id: int, body: bytes) -> None:
         """Deliver the enveloped message to each client in the envelope's
         client list (empty list → the envelope's player_id).  The whole
@@ -247,3 +295,23 @@ class ProxyRole(ServerRole):
             conn_id = self._client_conn.get(_ident_key(ident))
             if conn_id is not None:
                 self.server.send_raw(conn_id, msg_id, body)
+        # per-opcode forward-latency attribution (ISSUE 7 satellite):
+        # dispatch-tap arrival → fan-out complete, two clock reads
+        done = _time.perf_counter_ns()
+        self.games.counters.count_relay(msg_id, done - self._relay_arrival_ns)
+        self._relay_hist.observe((done - self._relay_arrival_ns) / 1e9)
+
+    def report(self):
+        r = super().report()
+        ext = r.server_info_list_ext
+        h = self._relay_hist
+        if h.count > 0:
+            ext.key.append(b"relay_p50_ms")
+            ext.value.append(
+                f"{h.percentile(50.0) * 1e3:.4f}".encode())
+            ext.key.append(b"relay_p95_ms")
+            ext.value.append(
+                f"{h.percentile(95.0) * 1e3:.4f}".encode())
+        ext.key.append(b"traces_relayed")
+        ext.value.append(str(self.traces_relayed).encode())
+        return r

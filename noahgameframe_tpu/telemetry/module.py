@@ -69,6 +69,15 @@ class TelemetryModule(Module):
             kind="counter", help="payload bytes per link/direction/opcode",
         )
         self.registry.register_callback(
+            "nf_relay_msgs_total", lambda: self._relay_samples(0),
+            kind="counter", help="proxy-forwarded messages per link/opcode",
+        )
+        self.registry.register_callback(
+            "nf_relay_seconds_total", lambda: self._relay_samples(1),
+            kind="counter",
+            help="cumulative proxy forward latency per link/opcode",
+        )
+        self.registry.register_callback(
             "nf_reconnects_total", self._pool_samples, kind="counter",
             help="re-dial attempts after a link failure, per pool/server",
         )
@@ -125,6 +134,19 @@ class TelemetryModule(Module):
                          "opcode": str(opcode)},
                         d[opcode],
                     )
+
+    def _relay_samples(self, which: int) -> Iterable[Tuple[dict, float]]:
+        """which: 0 = relayed message count, 1 = forward latency seconds.
+        Sourced from NetCounters.count_relay (net/module.py) — only the
+        proxy feeds these, so most roles yield nothing."""
+        for link, c in sorted(self._net_sources.items()):
+            msgs = getattr(c, "relay_msgs", None)
+            if not msgs:
+                continue
+            for opcode in sorted(msgs):
+                v = (msgs[opcode] if which == 0
+                     else c.relay_ns.get(opcode, 0) / 1e9)
+                yield ({"link": link, "opcode": str(opcode)}, v)
 
     def attach_role(self, role) -> None:
         """Wire a ServerRole: identity gauge + its net counter sources.

@@ -116,12 +116,17 @@ class Counter(Metric):
                  labelnames: Sequence[str] = ()) -> None:
         super().__init__(name, help, labelnames)
         self._values: Dict[LabelValues, float] = {}
+        # hot-path metrics are bumped from the tick thread AND the
+        # write-behind flusher thread: the read-modify-write below must
+        # not lose increments (ISSUE 7 satellite)
+        self._mu = threading.Lock()
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
         if amount < 0:
             raise ValueError(f"{self.name}: counter decrease ({amount})")
         key = self._key(labels)
-        self._values[key] = self._values.get(key, 0.0) + amount
+        with self._mu:
+            self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels: str) -> float:
         return self._values.get(self._key(labels), 0.0)
@@ -144,13 +149,16 @@ class Gauge(Metric):
         super().__init__(name, help, labelnames)
         self._values: Dict[LabelValues, float] = {}
         self._fn: Optional[Callable[[], float]] = None
+        self._mu = threading.Lock()
 
     def set(self, value: float, **labels: str) -> None:
-        self._values[self._key(labels)] = float(value)
+        with self._mu:
+            self._values[self._key(labels)] = float(value)
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
         key = self._key(labels)
-        self._values[key] = self._values.get(key, 0.0) + amount
+        with self._mu:
+            self._values[key] = self._values.get(key, 0.0) + amount
 
     def set_function(self, fn: Callable[[], float]) -> None:
         """Label-less gauge evaluated at scrape time."""
@@ -197,35 +205,47 @@ class Histogram(Metric):
         self._sum = 0.0
         self._count = 0
         self._window: Deque[float] = collections.deque(maxlen=window)
+        # observe() runs a multi-field read-modify-write from both the
+        # tick thread and the write-behind flusher; an unlocked race
+        # drops counts and skews _sum (ISSUE 7 satellite)
+        self._mu = threading.Lock()
 
     def observe(self, value: float) -> None:
         v = float(value)
-        self._sum += v
-        self._count += 1
-        self._window.append(v)
-        for i, ub in enumerate(self.buckets):
-            if v <= ub:
-                self._counts[i] += 1
-                return
-        self._counts[-1] += 1
+        with self._mu:
+            self._sum += v
+            self._count += 1
+            self._window.append(v)
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
 
     # -- exact window math (the one percentile implementation) -----------
     @property
     def count(self) -> int:
         return self._count
 
+    @property
+    def sum(self) -> float:
+        return self._sum
+
     def window_values(self) -> list:
-        return list(self._window)
+        with self._mu:
+            return list(self._window)
 
     def window_mean(self) -> float:
-        if not self._window:
-            return 0.0
-        return sum(self._window) / len(self._window)
+        with self._mu:
+            if not self._window:
+                return 0.0
+            return sum(self._window) / len(self._window)
 
     def percentile(self, p: float) -> float:
         """Exact p-th percentile (linear interpolation) over the sample
         window; 0.0 when empty."""
-        vals = sorted(self._window)
+        with self._mu:
+            vals = sorted(self._window)
         if not vals:
             return 0.0
         if len(vals) == 1:
@@ -237,14 +257,17 @@ class Histogram(Metric):
         return vals[lo] * (1.0 - frac) + vals[hi] * frac
 
     def samples(self) -> Iterable[Sample]:
+        with self._mu:  # consistent snapshot: sum/count/buckets agree
+            counts = list(self._counts)
+            total, n = self._sum, self._count
         cum = 0
-        for ub, c in zip(self.buckets, self._counts):
+        for ub, c in zip(self.buckets, counts):
             cum += c
             yield ("_bucket", {"le": _fmt_value(ub)}, float(cum))
-        cum += self._counts[-1]
+        cum += counts[-1]
         yield ("_bucket", {"le": "+Inf"}, float(cum))
-        yield ("_sum", {}, self._sum)
-        yield ("_count", {}, float(self._count))
+        yield ("_sum", {}, total)
+        yield ("_count", {}, float(n))
 
 
 class CallbackMetric(Metric):

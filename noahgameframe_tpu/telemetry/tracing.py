@@ -92,6 +92,13 @@ class SpanTracer:
     def __len__(self) -> int:
         return len(self._events)
 
+    @property
+    def epoch_ns(self) -> int:
+        """perf_counter_ns at construction/clear — ts=0 in the export.
+        To merge same-clock tracers, pass
+        ``offset_us=(tracer.epoch_ns - ref_epoch_ns) / 1e3``."""
+        return self._epoch_ns
+
     # ------------------------------------------------------------ export
     def events(self) -> List[tuple]:
         """Chronological (name, ts_ns, dur_ns, tid, args) tuples."""
@@ -99,9 +106,19 @@ class SpanTracer:
             ring = self._events[self._head:] + self._events[:self._head]
         return ring
 
-    def chrome_trace(self, process_name: str = "noahgameframe_tpu") -> dict:
-        """Chrome trace-event JSON object (Perfetto/about:tracing)."""
-        pid = os.getpid()
+    def chrome_trace(self, process_name: str = "noahgameframe_tpu",
+                     pid: Optional[int] = None,
+                     offset_us: float = 0.0) -> dict:
+        """Chrome trace-event JSON object (Perfetto/about:tracing).
+
+        ``pid`` overrides the OS pid so several tracers captured in one
+        process (LocalCluster roles) still render as distinct Perfetto
+        process tracks; ``offset_us`` shifts all timestamps onto a
+        reference clock (feed it a ClockSync offset / 1e3) so a
+        multi-process merge lines up — see
+        :func:`noahgameframe_tpu.telemetry.pipeline.merge_chrome_traces`.
+        """
+        pid = os.getpid() if pid is None else int(pid)
         tid_map: Dict[int, int] = {}
         trace_events: List[dict] = [
             {
@@ -117,7 +134,7 @@ class SpanTracer:
                 "pid": pid,
                 "tid": small_tid,
                 # trace-event timestamps are microseconds
-                "ts": (ts_ns - self._epoch_ns) / 1000.0,
+                "ts": (ts_ns - self._epoch_ns) / 1000.0 + offset_us,
             }
             if dur_ns < 0:
                 ev["ph"] = "i"

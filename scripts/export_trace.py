@@ -11,6 +11,16 @@ kernel dispatch/fetch/post stages record into
 instead, which wraps the run in a JAX profiler capture whose HLO ops
 carry the per-stage jax.named_scope names (nf.schedule, nf.phase.*,
 nf.diff) for XProf/TensorBoard.
+
+Merge mode (ISSUE 7) stitches per-role trace JSONs into ONE Perfetto
+timeline with aligned clocks:
+
+    python scripts/export_trace.py --merge game.json proxy.json \
+        --offsets-us 0,1234.5 --out cluster.json
+
+Offsets come from the master's /pipeline endpoint
+(``clock_offsets_ns``, NTP-style sliding-min estimates) or, for
+same-machine tracers, from ``SpanTracer.epoch_ns`` deltas.
 """
 
 from __future__ import annotations
@@ -22,6 +32,18 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def merge_files(paths, offsets_us, out: Path) -> int:
+    """Merge chrome-trace JSON docs into one timeline; returns event count."""
+    import json
+
+    from noahgameframe_tpu.telemetry.pipeline import merge_chrome_traces
+
+    docs = [json.loads(Path(p).read_text()) for p in paths]
+    merged = merge_chrome_traces(docs, offsets_us=offsets_us)
+    out.write_text(json.dumps(merged))
+    return len(merged["traceEvents"])
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--entities", type=int, default=1024)
@@ -30,7 +52,23 @@ def main() -> int:
     ap.add_argument("--xprof", type=Path, default=None,
                     help="also wrap the run in a JAX profiler capture "
                          "written to this log dir (open with TensorBoard)")
+    ap.add_argument("--merge", nargs="+", type=Path, default=None,
+                    metavar="TRACE_JSON",
+                    help="merge existing chrome-trace files into --out "
+                         "instead of running a capture")
+    ap.add_argument("--offsets-us", type=str, default=None,
+                    help="comma-separated per-file clock offsets (µs) "
+                         "added to each merged file's timestamps")
     args = ap.parse_args()
+
+    if args.merge is not None:
+        offsets = ([float(x) for x in args.offsets_us.split(",")]
+                   if args.offsets_us else None)
+        if offsets is not None and len(offsets) != len(args.merge):
+            ap.error("--offsets-us must list one offset per --merge file")
+        n = merge_files(args.merge, offsets, args.out)
+        print(f"merged {len(args.merge)} traces ({n} events) into {args.out}")
+        return 0
 
     import contextlib
 

@@ -29,11 +29,24 @@ PKG = Path(__file__).resolve().parent.parent / "noahgameframe_tpu"
 # must never include a wall clock — recovery flushes have to be
 # byte-identical to the flushes a crash interrupted
 SCANNED_DIRS = ("kernel", "ops", "game", "persist")
+# frame observatory (ISSUE 7): the stage clock and the trace wire path
+# (game emit/ack, proxy stamp, client echo) stamp with perf_counter_ns —
+# fine — but a time.time() anywhere on these paths could leak wall clock
+# into journaled inputs or compiled functions, so they join the scan
+EXTRA_FILES = (
+    "telemetry/pipeline.py",
+    "net/roles/base.py",
+    "net/roles/game.py",
+    "net/roles/proxy.py",
+    "client/sdk.py",
+)
 
 
 def _files():
     for d in SCANNED_DIRS:
         yield from sorted((PKG / d).rglob("*.py"))
+    for f in EXTRA_FILES:
+        yield PKG / f
 
 
 def _dotted(node):
@@ -230,3 +243,43 @@ def test_flusher_owns_every_store_call():
     # _flush_batch (called only from _run, the flusher thread) is the
     # single place store I/O happens
     assert callers == {"_flush_batch"}, callers
+
+
+# --- trace journal-exclusion contract (ISSUE 7): replay bit-identity
+# with tracing on vs off requires that FRAME_TRACE / FRAME_TRACE_ACK
+# events never enter the journal — the recorded input stream must not
+# depend on whether a session was sampled.  Enforced structurally: the
+# journal tap's write is guarded by a TRACE_MSG_IDS membership test.
+GAME_PATH = PKG / "net" / "roles" / "game.py"
+
+
+def _journal_tap_fn():
+    tree = ast.parse(GAME_PATH.read_text(), filename=str(GAME_PATH))
+    cls = next(n for n in tree.body
+               if isinstance(n, ast.ClassDef) and n.name == "GameRole")
+    outer = next(n for n in cls.body
+                 if isinstance(n, ast.FunctionDef)
+                 and n.name == "_journal_tap")
+    return next(n for n in ast.walk(outer)
+                if isinstance(n, ast.FunctionDef) and n.name == "tap")
+
+
+def test_journal_tap_excludes_trace_sidecars():
+    tap = _journal_tap_fn()
+    writes = [n for n in ast.walk(tap)
+              if isinstance(n, ast.Call)
+              and _dotted(n.func) is not None
+              and _dotted(n.func).endswith(".event")]
+    assert writes, "journal tap no longer writes events?"
+    guarded = [
+        n for n in ast.walk(tap)
+        if isinstance(n, ast.If)
+        and any(isinstance(x, ast.Name) and x.id == "TRACE_MSG_IDS"
+                for x in ast.walk(n.test))
+        and any(w in ast.walk(n) for w in writes)
+    ]
+    assert guarded, (
+        "journal writes are not guarded by a TRACE_MSG_IDS test — "
+        "trace sidecars would enter the journal and break replay "
+        "identity between traced and untraced runs"
+    )

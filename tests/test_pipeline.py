@@ -1,0 +1,33 @@
+"""Frame observatory e2e (ISSUE 7): the pipeline smoke as a test.
+
+scripts/pipeline_smoke.py boots the served five-role cluster with
+every session traced (NF_TRACE_SAMPLE=1) and a journaling game role,
+then proves the three tentpole claims in one run: the stage waterfall
+sums to the frame wall time, trace sidecars round-trip game → proxy →
+client → ack with per-hop stamps, and the journal + replay digests are
+bit-identical with tracing on vs off.  Unit coverage of the codec,
+merge, and clocks lives in tests/test_trace_codec.py.
+"""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "scripts" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_pipeline_smoke_e2e(tmp_path):
+    smoke = _load_script("pipeline_smoke")
+    checks = smoke.run(tmp_path)
+    failed = [name for name, ok in checks.items() if not ok]
+    assert not failed, f"pipeline smoke checks failed: {failed}"

@@ -256,6 +256,63 @@ def test_memory_census_logs_failing_probe_once(caplog):
     assert len(warnings) == 2
 
 
+# -------------------------------------------------------- thread safety
+# The registry is written from two threads in production: the pump thread
+# (tick metrics, stage clock) and the write-behind flusher (persist
+# telemetry).  Unlocked float += drops increments under contention; these
+# hammers assert exact totals (ISSUE 7 satellite).
+def _hammer(fn, threads=8, rounds=2000):
+    start = threading.Barrier(threads)
+
+    def work():
+        start.wait()
+        for _ in range(rounds):
+            fn()
+
+    ts = [threading.Thread(target=work) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return threads * rounds
+
+
+def test_counter_inc_is_thread_safe():
+    c = Counter("stress_total", "x")
+    n = _hammer(lambda: c.inc(1.0))
+    assert c.value() == n
+
+
+def test_histogram_observe_is_thread_safe():
+    h = Histogram("stress_seconds", "x", window=128, buckets=(0.5, 1.0))
+    n = _hammer(lambda: h.observe(0.25))
+    assert h.count == n
+    assert h.sum == pytest.approx(0.25 * n)
+    by_le = {labels["le"]: v for suffix, labels, v in h.samples()
+             if suffix == "_bucket"}
+    assert by_le["0.5"] == n and by_le["+Inf"] == n
+
+
+def test_histogram_percentile_during_concurrent_observe():
+    h = Histogram("race_seconds", "x", window=64)
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            h.percentile(50)
+            h.window_mean()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    try:
+        _hammer(lambda: h.observe(1.0), threads=4, rounds=3000)
+    finally:
+        stop.set()
+        t.join(timeout=2)
+    assert h.count == 12000
+    assert h.percentile(50) == pytest.approx(1.0)
+
+
 # ------------------------------------------------------------- net counters
 def test_net_counters_per_opcode():
     from noahgameframe_tpu.net.module import NetServerModule
@@ -286,3 +343,25 @@ def test_net_counters_per_opcode():
     assert srv.counters.out_bytes.get(43) == 3
     srv.shut()
     cli.close()
+
+
+def test_relay_counters_exposed_per_opcode():
+    """Proxy forward-latency attribution (ISSUE 7 satellite): NetCounters
+    absorbs count_relay and the TelemetryModule exposes both the count
+    and cumulative seconds under link/opcode labels."""
+    from noahgameframe_tpu.net.module import NetCounters
+    from noahgameframe_tpu.telemetry.module import TelemetryModule
+
+    c = NetCounters()
+    c.count_relay(301, 2_000_000)  # 2 ms
+    c.count_relay(301, 1_000_000)
+    c.count_relay(8004, 500_000)
+    assert c.relay_msgs == {301: 2, 8004: 1}
+    assert c.relay_ns == {301: 3_000_000, 8004: 500_000}
+
+    tm = TelemetryModule()
+    tm.add_net_source("games", c)
+    text = tm.exposition()
+    assert 'nf_relay_msgs_total{link="games",opcode="301"} 2' in text
+    assert 'nf_relay_seconds_total{link="games",opcode="301"} 0.003' in text
+    assert 'nf_relay_msgs_total{link="games",opcode="8004"} 1' in text
