@@ -6795,6 +6795,441 @@ namespace NFMsg
         }
     }
 
+    public class SwitchNotice
+    {
+        public int code = 0;
+        public bool HasCode = false;
+        public long target_serverid = 0;
+        public bool HasTargetServerid = false;
+        public long retry_after_ms = 0;
+        public bool HasRetryAfterMs = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasCode)
+            {
+                Nf.PutTag(nf__o, 1, 0);
+                Nf.PutI64(nf__o, (long)code);
+            }
+            if (HasTargetServerid)
+            {
+                Nf.PutTag(nf__o, 2, 0);
+                Nf.PutI64(nf__o, (long)target_serverid);
+            }
+            if (HasRetryAfterMs)
+            {
+                Nf.PutTag(nf__o, 3, 0);
+                Nf.PutI64(nf__o, (long)retry_after_ms);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            code = 0;
+            HasCode = false;
+            target_serverid = 0;
+            HasTargetServerid = false;
+            retry_after_ms = 0;
+            HasRetryAfterMs = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        code = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasCode = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        target_serverid = (long)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasTargetServerid = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        retry_after_ms = (long)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasRetryAfterMs = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class SessionBindNotify
+    {
+        public Ident selfid = new Ident();
+        public bool HasSelfid = false;
+        public byte[] account = Nf.Empty;
+        public bool HasAccount = false;
+        public byte[] name = Nf.Empty;
+        public bool HasName = false;
+        public Ident client_id = new Ident();
+        public bool HasClientId = false;
+        public long scene_id = 0;
+        public bool HasSceneId = false;
+        public long group_id = 0;
+        public bool HasGroupId = false;
+        public byte[] save_key = Nf.Empty;
+        public bool HasSaveKey = false;
+        public long game_id = 0;
+        public bool HasGameId = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasSelfid)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); selfid.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasAccount)
+            {
+                Nf.PutTag(nf__o, 2, 2);
+                Nf.PutBytes(nf__o, account);
+            }
+            if (HasName)
+            {
+                Nf.PutTag(nf__o, 3, 2);
+                Nf.PutBytes(nf__o, name);
+            }
+            if (HasClientId)
+            {
+                Nf.PutTag(nf__o, 4, 2);
+                var nf__sub = new MemoryStream(); client_id.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasSceneId)
+            {
+                Nf.PutTag(nf__o, 5, 0);
+                Nf.PutI64(nf__o, (long)scene_id);
+            }
+            if (HasGroupId)
+            {
+                Nf.PutTag(nf__o, 6, 0);
+                Nf.PutI64(nf__o, (long)group_id);
+            }
+            if (HasSaveKey)
+            {
+                Nf.PutTag(nf__o, 7, 2);
+                Nf.PutBytes(nf__o, save_key);
+            }
+            if (HasGameId)
+            {
+                Nf.PutTag(nf__o, 8, 0);
+                Nf.PutI64(nf__o, (long)game_id);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            selfid = new Ident();
+            HasSelfid = false;
+            account = Nf.Empty;
+            HasAccount = false;
+            name = Nf.Empty;
+            HasName = false;
+            client_id = new Ident();
+            HasClientId = false;
+            scene_id = 0;
+            HasSceneId = false;
+            group_id = 0;
+            HasGroupId = false;
+            save_key = Nf.Empty;
+            HasSaveKey = false;
+            game_id = 0;
+            HasGameId = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        selfid = nf__m; HasSelfid = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        account = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasAccount = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        name = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasName = true;
+                        break;
+                    }
+                    case 4:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        client_id = nf__m; HasClientId = true;
+                        break;
+                    }
+                    case 5:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        scene_id = (long)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasSceneId = true;
+                        break;
+                    }
+                    case 6:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        group_id = (long)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasGroupId = true;
+                        break;
+                    }
+                    case 7:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        save_key = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        HasSaveKey = true;
+                        break;
+                    }
+                    case 8:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        game_id = (long)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasGameId = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
+    public class SwitchRefused
+    {
+        public Ident selfid = new Ident();
+        public bool HasSelfid = false;
+        public long self_serverid = 0;
+        public bool HasSelfServerid = false;
+        public long target_serverid = 0;
+        public bool HasTargetServerid = false;
+        public int result = 0;
+        public bool HasResult = false;
+        public void Encode(MemoryStream nf__o)
+        {
+            if (HasSelfid)
+            {
+                Nf.PutTag(nf__o, 1, 2);
+                var nf__sub = new MemoryStream(); selfid.Encode(nf__sub);
+                Nf.PutBytes(nf__o, nf__sub.ToArray());
+            }
+            if (HasSelfServerid)
+            {
+                Nf.PutTag(nf__o, 2, 0);
+                Nf.PutI64(nf__o, (long)self_serverid);
+            }
+            if (HasTargetServerid)
+            {
+                Nf.PutTag(nf__o, 3, 0);
+                Nf.PutI64(nf__o, (long)target_serverid);
+            }
+            if (HasResult)
+            {
+                Nf.PutTag(nf__o, 4, 0);
+                Nf.PutI64(nf__o, (long)result);
+            }
+        }
+        public byte[] Encode()
+        {
+            var nf__o = new MemoryStream(); Encode(nf__o); return nf__o.ToArray();
+        }
+        public void Clear()
+        {
+            selfid = new Ident();
+            HasSelfid = false;
+            self_serverid = 0;
+            HasSelfServerid = false;
+            target_serverid = 0;
+            HasTargetServerid = false;
+            result = 0;
+            HasResult = false;
+        }
+        public bool Decode(byte[] nf__data, int nf__off, int nf__len)
+        {
+            Clear();
+            var nf__r = new NfReader(nf__data, nf__off, nf__len);
+            while (!nf__r.Done())
+            {
+                ulong nf__key = nf__r.Varint();
+                if (!nf__r.Ok) return false;
+                switch ((uint)(nf__key >> 3))
+                {
+                    case 1:
+                    {
+                        if ((uint)(nf__key & 7) != 2)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        var nf__sub = nf__r.Bytes();
+                        if (!nf__r.Ok) return false;
+                        var nf__m = new Ident();
+                        if (!nf__m.Decode(nf__sub, 0, nf__sub.Length)) return false;
+                        selfid = nf__m; HasSelfid = true;
+                        break;
+                    }
+                    case 2:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        self_serverid = (long)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasSelfServerid = true;
+                        break;
+                    }
+                    case 3:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        target_serverid = (long)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasTargetServerid = true;
+                        break;
+                    }
+                    case 4:
+                    {
+                        if ((uint)(nf__key & 7) != 0)
+                        {
+                            nf__r.Skip((uint)(nf__key & 7));
+                            if (!nf__r.Ok) return false;
+                            break;
+                        }
+                        result = (int)nf__r.Varint();
+                        if (!nf__r.Ok) return false;
+                        HasResult = true;
+                        break;
+                    }
+                    default:
+                        nf__r.Skip((uint)(nf__key & 7));
+                        if (!nf__r.Ok) return false;
+                        break;
+                }
+            }
+            return nf__r.Ok;
+        }
+    }
+
     public class ReqEnterGameServer
     {
         public Ident id = new Ident();

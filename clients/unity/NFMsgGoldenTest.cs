@@ -72,6 +72,9 @@ public static class NFMsgGoldenTest
             case "ReqSetFightHero": { var m = new NFMsg.ReqSetFightHero(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "RoleOnlineNotify": { var m = new NFMsg.RoleOnlineNotify(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "RoleOfflineNotify": { var m = new NFMsg.RoleOfflineNotify(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "SwitchNotice": { var m = new NFMsg.SwitchNotice(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "SessionBindNotify": { var m = new NFMsg.SessionBindNotify(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
+            case "SwitchRefused": { var m = new NFMsg.SwitchRefused(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "ReqEnterGameServer": { var m = new NFMsg.ReqEnterGameServer(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "PlayerEntryInfo": { var m = new NFMsg.PlayerEntryInfo(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }
             case "AckPlayerEntryList": { var m = new NFMsg.AckPlayerEntryList(); if (!m.Decode(raw, 0, raw.Length)) return null; return m.Encode(); }

@@ -128,6 +128,10 @@ class GameClient:
         self.pvp_ectypes: list = []   # AckCreatePVPEctype (instance grants)
         # frame observatory: received trace sidecars (bounded), acked back
         self.traces: List[dict] = []
+        # session failover (ISSUE 10): proxy control notices — REHOMING
+        # while a crashed binding re-homes, BUSY with a retry hint when
+        # no survivor has capacity, DROPPED when parked frames were lost
+        self.switch_notices: list = []
         self._handlers: Dict[int, Callable[[MsgBase], None]] = {}
         self._install()
 
@@ -198,6 +202,10 @@ class GameClient:
                                                  AckPVPApplyMatch)
         h[int(MsgID.ACK_CREATE_PVP_ECTYPE)] = keep(self.pvp_ectypes,
                                                    AckCreatePVPEctype)
+        from ..net.wire import SwitchNotice
+
+        h[int(MsgID.ACK_SWITCH_NOTICE)] = keep(self.switch_notices,
+                                               SwitchNotice)
 
     def connect(self, host: str, port: int) -> None:
         """Dial an endpoint (login first, later the granted proxy)."""

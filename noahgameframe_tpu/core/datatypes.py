@@ -29,7 +29,7 @@ import dataclasses
 import enum
 import threading
 import time as _time
-from typing import Any, Tuple, Union
+from typing import Any, Optional, Tuple, Union
 
 import numpy as np
 
@@ -121,9 +121,31 @@ class GuidAllocator:
         self._app_id = int(app_id)
         self._lock = threading.Lock()
         self._last = 0
+        # pinned = deterministic mode: the clock is never read again and
+        # every allocation is last+1.  A recording role pins at journal
+        # setup (the seed goes into journal meta) so replay can mint the
+        # exact guid sequence — wire messages carry guids back into
+        # mutating handlers, which makes the clock a hidden replay input
+        self.pinned = False
+
+    def pin(self, last: Optional[int] = None) -> int:
+        """Switch to pure-counter allocation; returns the seed (the
+        point the counter continues from).  With no argument the seed is
+        the current clock reading, so pinned and unpinned allocators
+        stay in disjoint ranges in practice."""
+        with self._lock:
+            if last is not None:
+                self._last = int(last)
+            elif self._last == 0:
+                self._last = int(_time.time() * 1_000_000)
+            self.pinned = True
+            return self._last
 
     def next(self) -> Guid:
         with self._lock:
+            if self.pinned:
+                self._last += 1
+                return Guid(self._app_id, self._last)
             now = int(_time.time() * 1_000_000)
             if now <= self._last:
                 now = self._last + 1
@@ -134,9 +156,12 @@ class GuidAllocator:
         """n distinct guids under ONE lock acquisition + clock read — the
         bulk-create fast path (create_many at 1M NPCs)."""
         with self._lock:
-            now = int(_time.time() * 1_000_000)
-            if now <= self._last:
+            if self.pinned:
                 now = self._last + 1
+            else:
+                now = int(_time.time() * 1_000_000)
+                if now <= self._last:
+                    now = self._last + 1
             self._last = now + n - 1
             app = self._app_id
             return [Guid(app, now + i) for i in range(n)]

@@ -355,10 +355,13 @@ class ChaosDirector:
         self.counts: Dict[str, Dict[str, int]] = {}
         self.logs: Dict[str, list] = {}
         self.rngs: Dict[str, random.Random] = {}
+        # every live wrapper, so heal() can flip faults off mid-run
+        # (a re-dial's fresh wrapper re-reads the — by then healed — plan)
+        self._live: List[Tuple[str, object]] = []
 
     def wrap(self, transport, link: str) -> FaultyTransport:
         link = str(link)
-        return FaultyTransport(
+        w = FaultyTransport(
             transport, link, self.plan,
             counts=self.counts.setdefault(link, {}),
             log=self.logs.setdefault(link, []),
@@ -366,6 +369,8 @@ class ChaosDirector:
                 (int(self.plan.seed) * 1000003) ^ zlib.crc32(link.encode())
             )),
         )
+        self._live.append((link, w))
+        return w
 
     def wrap_store(self, backend, link: str) -> FaultyStore:
         """Wrap a write-behind store backend the same way `wrap` wraps
@@ -373,7 +378,7 @@ class ChaosDirector:
         rebuilt pipeline continues the SAME fault schedule (op counts
         and first-N budgets do not reset)."""
         link = str(link)
-        return FaultyStore(
+        w = FaultyStore(
             backend, link, self.plan,
             counts=self.counts.setdefault(link, {}),
             log=self.logs.setdefault(link, []),
@@ -381,6 +386,38 @@ class ChaosDirector:
                 (int(self.plan.seed) * 1000003) ^ zlib.crc32(link.encode())
             )),
         )
+        self._live.append((link, w))
+        return w
+
+    def heal(self, pattern: Optional[str] = None) -> int:
+        """Turn faults OFF for every link whose name contains `pattern`
+        (all links when None), effective immediately on live wrappers
+        and on any future re-dial.  Returns how many live wrappers were
+        healed.
+
+        This is the failover-drill shape (ISSUE 10): inject faults
+        through the kill window, then heal and assert the cluster
+        actually converges — a plan that stays hostile forever can mask
+        a recovery path that never finishes.  Counts/logs are kept;
+        only the schedules reset."""
+        if pattern is None:
+            self.plan.links.clear()
+            self.plan.default = LinkFaults()
+            self.plan.stores.clear()
+            self.plan.store_default = StoreFaults()
+        else:
+            self.plan.links = {p: f for p, f in self.plan.links.items()
+                               if p not in pattern and pattern not in p}
+            self.plan.stores = {p: f for p, f in self.plan.stores.items()
+                                if p not in pattern and pattern not in p}
+        healed = 0
+        for link, w in self._live:
+            if pattern is not None and pattern not in link:
+                continue
+            w.faults = (StoreFaults() if isinstance(w, FaultyStore)
+                        else LinkFaults())
+            healed += 1
+        return healed
 
     def total(self, kind: Optional[str] = None) -> int:
         return sum(

@@ -30,6 +30,15 @@ class ServerState(enum.IntEnum):
     MAINTEN = 4
 
 
+class SwitchNoticeCode(enum.IntEnum):
+    """ACK_SWITCH_NOTICE codes (TPU-native; no reference equivalent —
+    the reference lets orphaned clients time out on a dead game)."""
+
+    REHOMING = 1  # bound game died; failover in progress, frames parked
+    BUSY = 2      # no survivor has capacity right now; retry after delay
+    DROPPED = 3   # parked frames were dropped (deadline or overflow)
+
+
 class EventCode(enum.IntEnum):
     SUCCESS = 0
     UNKNOWN_ERROR = 1
@@ -145,6 +154,18 @@ class MsgID(enum.IntEnum):
     # recorder journal so replays stay bit-identical with tracing on.
     FRAME_TRACE = 8004
     FRAME_TRACE_ACK = 8005
+    # session failover (ISSUE 10): proxy -> client notice that the bound
+    # game died and the session is being re-homed (or was given up on) —
+    # clients see an explicit BUSY/retry-after instead of a silent stall
+    ACK_SWITCH_NOTICE = 8006
+    # game -> world sidecar to ACK_ONLINE_NOTIFY carrying the session
+    # metadata (account/name/client ident/scene/group/save key) the
+    # world's failover driver needs to re-home the player after the
+    # owning game dies without being asked
+    SESSION_BIND_NOTIFY = 8007
+    # target game -> world: staged switch-in refused (capacity / torn
+    # blob) — the reference AckSwitchServer has no failure leg
+    ACK_SWITCH_REFUSED = 8008
 
     # in-game actions
     REQ_MOVE = 1230

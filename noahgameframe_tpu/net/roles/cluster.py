@@ -40,6 +40,8 @@ class LocalCluster:
         lease_suspect_seconds: Optional[float] = None,
         lease_down_seconds: Optional[float] = None,
         game_kwargs: Optional[dict] = None,
+        game_kwargs_by_name: Optional[Dict[str, dict]] = None,
+        world_kwargs: Optional[dict] = None,
     ) -> None:
         host = "127.0.0.1"
         self._backend = backend
@@ -48,6 +50,12 @@ class LocalCluster:
         # extra GameRole kwargs (checkpoint_dir, checkpoint_seconds, …)
         # remembered so revive_role() rebuilds an identical role
         self._game_kwargs = dict(game_kwargs or {})
+        # per-role overrides keyed by config name ("Game1", "Game2"):
+        # failover drills need each game on its OWN wal/checkpoint dirs —
+        # a shared dict would have every game scribbling over one WAL
+        self._game_kwargs_by_name = {
+            k: dict(v) for k, v in (game_kwargs_by_name or {}).items()
+        }
         # killed-role configs by config name, revivable later
         self._killed: Dict[str, RoleConfig] = {}
         self.chaos: Optional[ChaosDirector] = None
@@ -58,6 +66,9 @@ class LocalCluster:
         if lease_down_seconds is not None:
             master_kw["lease_down_seconds"] = lease_down_seconds
             world_kw["lease_down_seconds"] = lease_down_seconds
+        # caller-supplied WorldRole kwargs (recover_store for the
+        # failover driver's store fallback, failover=False to opt out…)
+        world_kw.update(world_kwargs or {})
         self.master = MasterRole(
             RoleConfig(1, int(ServerType.MASTER), "Master1", host, 0),
             backend=backend,
@@ -85,13 +96,14 @@ class LocalCluster:
         )
         self.games: List[GameRole] = []
         for i in range(n_games):
+            name = f"Game{i + 1}"
             self.games.append(
                 GameRole(
                     RoleConfig(6 + i * 10, int(ServerType.GAME),
-                               f"Game{i + 1}", host, 0, targets=world_t),
+                               name, host, 0, targets=world_t),
                     backend=backend,
                     world=game_world if i == 0 else None,
-                    **self._game_kwargs,
+                    **self._merged_game_kwargs(name),
                 )
             )
         self.game = self.games[0]
@@ -99,6 +111,11 @@ class LocalCluster:
         # speed up the registration/report cadence for in-process runs
         for role in self.roles:
             self._speed_role(role)
+
+    def _merged_game_kwargs(self, name: str) -> dict:
+        kw = dict(self._game_kwargs)
+        kw.update(self._game_kwargs_by_name.get(name, {}))
+        return kw
 
     def _speed_role(self, role) -> None:
         """Scale every outbound pool's cadence to the cluster keepalive:
@@ -227,13 +244,22 @@ class LocalCluster:
             )
 
     # ----------------------------------------------------- kill / revive
-    def kill_role(self, role) -> RoleConfig:
-        """Hard-kill one role: sockets dropped, removed from the pump.
+    def kill_role(self, role, hard: bool = False) -> RoleConfig:
+        """Kill one role: sockets dropped, removed from the pump.
         Accepts the role object or its config name.  Returns the config
-        (revive_role uses the remembered name)."""
+        (revive_role uses the remembered name).
+
+        ``hard=True`` uses the role's crash path (:meth:`GameRole.kill`)
+        — no session saves, no persist drain, the WAL keeps whatever
+        reached it.  That is the failover-drill semantics: the world
+        must recover from durable state alone.  Default stays the
+        graceful :meth:`shut`."""
         if isinstance(role, str):
             role = next(r for r in self.roles if r.config.name == role)
-        role.shut()
+        if hard and hasattr(role, "kill"):
+            role.kill()
+        else:
+            role.shut()
         self.roles.remove(role)
         if role in self.games:
             self.games.remove(role)
@@ -254,7 +280,7 @@ class LocalCluster:
             raise NotImplementedError(
                 f"revive_role supports game roles only, not {name}"
             )
-        kwargs = dict(self._game_kwargs)
+        kwargs = self._merged_game_kwargs(cfg.name)
         kwargs["resume"] = resume
         role = GameRole(
             RoleConfig(cfg.server_id, cfg.server_type, cfg.name,

@@ -356,6 +356,14 @@ class GameRole(ServerRole):
             )
 
             cfg = self.game_world.config
+            # guid allocation is wall-clock seeded (epoch micros); wire
+            # messages CARRY guids back into mutating handlers (e.g. the
+            # switch ack destroys by guid), so an unpinned clock is a
+            # hidden replay input.  Pin the allocator to a pure counter
+            # from here on and journal the seed — replay pins the
+            # offline role to the same point and every post-pin guid
+            # comes out bit-identical (ISSUE 10)
+            guid_seed = self.kernel.store.guids.pin()
             self.journal = JournalWriter(
                 self._journal_dir,
                 segment_bytes=self._journal_segment_bytes,
@@ -367,6 +375,7 @@ class GameRole(ServerRole):
                     "start_tick": self.kernel.tick_count,
                     "resumed": bool(resume),
                     "verlet_skin": float(skin_from_env()),
+                    "guid_seed": int(guid_seed),
                 },
             )
             # tap BOTH dispatch choke points: client/proxy traffic on the
@@ -546,9 +555,16 @@ class GameRole(ServerRole):
                 ("persist_lag_ticks", self.persist.lag_ticks()),
                 ("persist_queue_depth", self.persist.queue_depth()),
                 ("persist_degraded", int(self.persist.degraded())),
+                # durable-media locations for the world's failover
+                # driver (ISSUE 10): when THIS role dies, the world
+                # reconstructs its players' blobs read-only from here
+                ("wal_dir", str(self.persist.wal.path)),
             ):
                 ext.key.append(k.encode())
                 ext.value.append(str(v).encode())
+        if self.checkpoint_dir is not None:
+            ext.key.append(b"ckpt_dir")
+            ext.value.append(str(self.checkpoint_dir).encode())
         # frame-pipeline attribution blob: the master's /pipeline route
         # parses this into the cluster-wide stage waterfall
         ext.key.append(b"pipeline")
@@ -843,12 +859,37 @@ class GameRole(ServerRole):
         self._send_to_session(sess, MsgID.ACK_ENTER_GAME, ack)
         self._send_snapshots(sess)
         if self.cross_server_sync:
-            from ..wire import RoleOnlineNotify
+            self._notify_online(sess, guid, self.scene_id, 0)
 
-            self.world_link.send_to_all(
-                int(MsgID.ACK_ONLINE_NOTIFY),
-                wrap(RoleOnlineNotify(), player_id=guid_ident(guid)),
-            )
+    def _notify_online(self, sess: Session, guid: Guid,
+                       scene_id: int, group_id: int) -> None:
+        """Cross-server online notify + session-bind sidecar (ISSUE 10):
+        the world's roster learns the player came online, and its
+        failover driver learns everything needed to re-home this session
+        — durable save key included — should this role die unasked."""
+        from ..wire import RoleOnlineNotify, SessionBindNotify
+
+        ident = guid_ident(guid)
+        self.world_link.send_to_all(
+            int(MsgID.ACK_ONLINE_NOTIFY),
+            wrap(RoleOnlineNotify(), player_id=ident),
+        )
+        save_key = ""
+        if self.data_agent is not None:
+            save_key = self.data_agent._key_of(guid) or ""
+        bind = SessionBindNotify(
+            selfid=ident,
+            account=(sess.account or "").encode(),
+            name=str(self.kernel.get_property(guid, "Name") or "").encode(),
+            client_id=sess.ident,
+            scene_id=int(scene_id),
+            group_id=int(group_id),
+            save_key=save_key.encode(),
+            game_id=int(self.config.server_id),
+        )
+        self.world_link.send_to_all(
+            int(MsgID.SESSION_BIND_NOTIFY), wrap(bind)
+        )
 
     def _on_leave_game(self, conn_id: int, _msg_id: int, body: bytes) -> None:
         base, _ = unwrap(body)
@@ -1467,16 +1508,39 @@ class GameRole(ServerRole):
     def _on_switch_in(self, _sid: int, _msg_id: int, body: bytes) -> None:
         """Target side (OnReqSwichServer,
         NFCGSSwichServerModule.cpp:96-148): recreate the player from the
-        blob, enter the scene, bind the client, re-route the proxy, ack."""
+        blob, enter the scene, bind the client, re-route the proxy, ack.
+
+        Hardened for supervised failover (ISSUE 10): a duplicate REQ
+        re-acks idempotently, a full Player store answers
+        ACK_SWITCH_REFUSED (BUSY) instead of half-admitting, and a blob
+        torn in transit destroys the half-built object and refuses —
+        the driver retries another survivor in every refusal case."""
         from ...persist.codec import apply_snapshot
+        from ..failover import REFUSE_BAD_BLOB, REFUSE_BUSY
         _, req = unwrap(body, ReqSwitchServer)
         if int(req.target_serverid) != self.config.server_id:
             return
+        if req.client_id is None or req.selfid is None:
+            return
+        ckey = _ident_key(req.client_id)
         staged = self._switch_blobs.pop(_ident_key(req.selfid), None)
-        if staged is None or req.client_id is None:
+        if staged is None:
+            # duplicate REQ (dup'd link, or a failover re-stage racing
+            # the first ack): if this client already owns a live avatar
+            # here, repeat the re-route + ack instead of going silent —
+            # the world-side driver needs the (possibly lost) ack
+            sess = self.sessions.get(ckey)
+            if sess is not None and sess.guid is not None:
+                self._switch_accept(req, sess)
             return
         data = staged[0]
         k = self.kernel
+        store = k.store
+        if store.live_count("Player") >= store.capacity("Player"):
+            # graceful degradation: no capacity for the refugee — refuse
+            # BEFORE allocating so the driver can try another survivor
+            self._switch_refuse(req, REFUSE_BUSY)
+            return
         guid = k.create_object(
             "Player",
             {
@@ -1486,13 +1550,21 @@ class GameRole(ServerRole):
             },
             scene=int(req.scene_id), group=int(req.group_id),
         )
-        k.state = apply_snapshot(k.store, k.state, guid, data.blob)
+        if data.blob:
+            try:
+                k.state = apply_snapshot(k.store, k.state, guid, data.blob)
+            except Exception:
+                # torn blob: k.state only mutates on success, so a clean
+                # destroy admits nothing half-applied
+                if guid in k.store.guid_map:
+                    k.destroy_object(guid)
+                self._switch_refuse(req, REFUSE_BAD_BLOB)
+                return
         k.state = k.store.set_property(k.state, guid, "GameID",
                                        self.config.server_id)
         # bind the client session; the transport conn resolves to the
         # proxy link (single-proxy fast path) and self-corrects on the
         # client's first routed message (_session_for)
-        ckey = _ident_key(req.client_id)
         sess = self.sessions.get(ckey)
         if sess is None:
             sess = Session(ident=req.client_id, conn_id=-1)
@@ -1500,11 +1572,21 @@ class GameRole(ServerRole):
         sess.account = data.account.decode("utf-8", "replace")
         sess.guid = guid
         self._guid_session[guid] = ckey
+        self._enter_scene(guid, int(req.scene_id),
+                          group=int(req.group_id) or 1)
+        self._switch_accept(req, sess)
+        if self.cross_server_sync:
+            # adopted players rejoin the roster under THIS game id, so a
+            # second failure can re-home them again (roster continuity)
+            self._notify_online(sess, guid, int(req.scene_id),
+                                int(req.group_id))
+
+    def _switch_accept(self, req, sess: Session) -> None:
+        """Re-route the proxy binding and ack the switch — shared by the
+        first admit and the duplicate-REQ idempotent repeat."""
         proxy_conns = list(self.server.conn_tags)
         if len(proxy_conns) == 1:
             sess.conn_id = proxy_conns[0]
-        self._enter_scene(guid, int(req.scene_id),
-                          group=int(req.group_id) or 1)
         # proxy re-route: every proxy link gets the req; the one owning
         # the client ident re-points it at this server
         for conn in proxy_conns:
@@ -1517,6 +1599,19 @@ class GameRole(ServerRole):
             gate_serverid=req.gate_serverid,
         )
         self.world_link.send_to_all(int(MsgID.ACK_SWITCH_SERVER), wrap(ack))
+
+    def _switch_refuse(self, req, result: int) -> None:
+        from ..wire import SwitchRefused
+
+        self.world_link.send_to_all(
+            int(MsgID.ACK_SWITCH_REFUSED),
+            wrap(SwitchRefused(
+                selfid=req.selfid,
+                self_serverid=int(req.self_serverid),
+                target_serverid=int(req.target_serverid),
+                result=int(result),
+            )),
+        )
 
     def _on_switch_ack(self, _sid: int, _msg_id: int, body: bytes) -> None:
         """Origin side (OnAckSwichServer): the target owns the player
@@ -1703,6 +1798,21 @@ class GameRole(ServerRole):
             # mutually recoverable
             self.persist.barrier(self.kernel.tick_count)
         return self.checkpoint_dir
+
+    def kill(self) -> None:
+        """Crash semantics (ISSUE 10 failover drills): tear the sockets
+        down WITHOUT the graceful drain — no session saves, no persist
+        flush, the WAL keeps whatever reached it.  This is the in-process
+        stand-in for kill -9; :meth:`shut` is the orderly exit."""
+        ServerRole.shut(self)
+        if self.journal is not None:
+            self.journal.close()
+            self.journal = None
+        if self.persist is not None:
+            self.persist.kill()
+            if self.data_agent is not None:
+                self.data_agent.pipeline = None
+            self.persist = None
 
     def shut(self) -> None:
         # pending-save drain: stage every live session player BEFORE the

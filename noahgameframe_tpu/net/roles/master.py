@@ -275,6 +275,25 @@ class MasterRole(ServerRole):
                 status["chaos"] = self.chaos_status()
             except Exception:  # noqa: BLE001 — a dead probe must not kill /json
                 status["chaos"] = {"error": "chaos status unavailable"}
+        # session-failover health (ISSUE 10): each world's heartbeat ext
+        # carries pending re-homes + oldest-pending age; aggregate them
+        # so operators see a stuck failover without scraping every world
+        fo: Dict[str, dict] = {}
+        for sid, reg in sorted(
+            self.registry.get(int(ServerType.WORLD), {}).items()
+        ):
+            ext = self._ext_of(reg.report)
+            if "failover_pending" not in ext:
+                continue
+            try:
+                fo[str(sid)] = {
+                    "pending": int(ext.get("failover_pending", "0")),
+                    "lag_s": float(ext.get("failover_lag", "0")),
+                }
+            except ValueError:
+                fo[str(sid)] = {"error": "unparseable failover ext"}
+        if fo:
+            status["failover"] = fo
         return status
 
     def pipeline_status(self) -> dict:
@@ -328,7 +347,8 @@ class MasterRole(ServerRole):
     def _fallback_page(self) -> str:
         """Server-rendered table (no-JS fallback)."""
         rows = []
-        for group, servers in self.servers_status()["servers"].items():
+        status = self.servers_status()
+        for group, servers in status["servers"].items():
             for s in servers:
                 try:
                     state = ServerState(s["state"]).name
@@ -343,6 +363,15 @@ class MasterRole(ServerRole):
                     persist = f"lag {html.escape(str(ext['persist_lag_ticks']))}"
                     if str(ext.get("persist_degraded", "0")) != "0":
                         persist += " <b>DEGRADED</b>"
+                elif "failover_pending" in ext:
+                    # world rows repurpose the column for failover health
+                    persist = (
+                        f"failover {html.escape(str(ext['failover_pending']))}"
+                        f" pending, lag "
+                        f"{html.escape(str(ext.get('failover_lag', '0')))}s"
+                    )
+                    if str(ext.get("failover_pending", "0")) != "0":
+                        persist = f"<b>{persist}</b>"
                 else:
                     persist = "&mdash;"
                 rows.append(

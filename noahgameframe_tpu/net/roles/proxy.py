@@ -19,7 +19,8 @@ import time as _time
 from typing import Dict, Optional, Tuple
 
 from ...telemetry.pipeline import TraceError, decode_trace, encode_trace
-from ..defines import EventCode, MsgID, ServerState, ServerType
+from ..defines import EventCode, MsgID, ServerState, ServerType, SwitchNoticeCode
+from ..failover import ParkingBuffer
 from ..module import NORMAL, NetClientModule
 from ..transport import EV_DISCONNECTED, EV_MSG
 from ..wire import (
@@ -29,6 +30,7 @@ from ..wire import (
     MsgBase,
     ReqAccountLogin,
     ReqSelectServer,
+    SwitchNotice,
     ident_key as _ident_key,
     unwrap,
     wrap,
@@ -42,6 +44,9 @@ class ProxyRole(ServerRole):
     server_type = int(ServerType.PROXY)
 
     KEY_TTL_S = 120.0  # a grant the client never redeems expires
+    #: retry hint carried in BUSY/REHOMING notices — roughly one lease
+    #: refresh, by which time the world's failover has usually re-staged
+    RETRY_AFTER_MS = 500
 
     def __init__(self, config: RoleConfig, backend: str = "auto") -> None:
         # account -> (world-minted connect key, expiry monotonic time);
@@ -86,6 +91,11 @@ class ProxyRole(ServerRole):
             "game→client transpond relay latency (arrival to fan-out done)",
         )
         self.traces_relayed = 0
+        # session failover (ISSUE 10): frames headed for a dead/absent
+        # binding park here instead of dropping, and replay in order once
+        # the world's driver re-homes the session and the target's
+        # re-point lands (_on_switch_route)
+        self.parking = ParkingBuffer(registry=self.telemetry.registry)
 
     def _install(self) -> None:
         s = self.server
@@ -112,6 +122,7 @@ class ProxyRole(ServerRole):
         """Reconcile the outbound pool against World's authoritative game
         list: add new, re-dial changed endpoints, prune vanished servers
         (a restarted game comes back on a new ephemeral port)."""
+        before = set(self.games.servers)
         seen = set()
         for r in decode_reports(body):
             if int(r.server_state) == int(ServerState.CRASH):
@@ -133,6 +144,34 @@ class ProxyRole(ServerRole):
         for sid in list(self.games.servers):
             if sid not in seen:
                 self.games.remove_server(sid)
+        # satellite 2: a prune used to silently unbind every client on
+        # the vanished game — their messages fell into the void with no
+        # signal.  Tell them explicitly: failover is re-homing you, park
+        # in the meantime, retry after a beat if nothing arrives.  Only
+        # the transition fires (`before - seen`), so a game that stays
+        # CRASH across refreshes does not re-notify every push.
+        gone = {int(s) for s in before - seen}
+        if gone:
+            for conn_id, info in self._conn_info.items():
+                gid = info.get("game_id")
+                if gid is not None and int(gid) in gone:
+                    self._notify_switch(
+                        conn_id, SwitchNoticeCode.REHOMING, int(gid),
+                        self.RETRY_AFTER_MS,
+                    )
+
+    def _notify_switch(self, conn_id: int, code: SwitchNoticeCode,
+                       target_sid: int, retry_after_ms: int) -> None:
+        """Push an ACK_SWITCH_NOTICE control frame to one client (the
+        reference has no equivalent — orphaned clients just time out)."""
+        notice = SwitchNotice(
+            code=int(code),
+            target_serverid=int(target_sid),
+            retry_after_ms=int(retry_after_ms),
+        )
+        self.server.send_raw(
+            conn_id, int(MsgID.ACK_SWITCH_NOTICE), wrap(notice)
+        )
 
     # ------------------------------------------------------ client side
     def _on_connect_key(self, conn_id: int, _msg_id: int, body: bytes) -> None:
@@ -199,7 +238,31 @@ class ProxyRole(ServerRole):
         out = base.encode()
         game_id = tags.get("game_id")
         if game_id is not None:
-            self.games.send_by_server_id(game_id, msg_id, out)
+            # order guard: while frames are parked for this session, new
+            # arrivals must queue BEHIND them even if the (re-pointed)
+            # binding is already sendable — a direct send here would
+            # overtake the parked prefix
+            if self.parking.depth(conn_id):
+                dropped = self.parking.park(
+                    conn_id, msg_id, out, _time.monotonic()
+                )
+                if dropped:
+                    self._notify_switch(
+                        conn_id, SwitchNoticeCode.DROPPED, int(game_id),
+                        self.RETRY_AFTER_MS,
+                    )
+            elif not self.games.send_by_server_id(game_id, msg_id, out):
+                # bound game is gone or not NORMAL: park instead of drop
+                # — failover is (or will be) re-homing this session, and
+                # _on_switch_route replays the queue in order
+                dropped = self.parking.park(
+                    conn_id, msg_id, out, _time.monotonic()
+                )
+                if dropped:
+                    self._notify_switch(
+                        conn_id, SwitchNoticeCode.DROPPED, int(game_id),
+                        self.RETRY_AFTER_MS,
+                    )
         else:
             self.games.send_by_suit(tags.get("account", ""), msg_id, out)
 
@@ -209,6 +272,9 @@ class ProxyRole(ServerRole):
         self._client_conn = {
             k: c for k, c in self._client_conn.items() if c != conn_id
         }
+        # anything still parked for a dead client socket has no receiver
+        # for its replies either — drop it (counted reason="disconnect")
+        self.parking.discard(conn_id)
         # tell the game its player is gone (the reference proxy fires
         # REQ_LEAVE_GAME upstream when a client socket dies)
         info = self._conn_info.pop(conn_id, None)
@@ -247,6 +313,15 @@ class ProxyRole(ServerRole):
         info = self._conn_info.get(conn_id)
         if info is not None:
             info["game_id"] = int(req.target_serverid)
+        # new binding is live: replay anything parked while the old one
+        # was dead, in arrival order.  A failed send leaves the rest
+        # parked; _parking_pump retries on the next execute pass.
+        if self.parking.depth(conn_id):
+            target = int(req.target_serverid)
+            self.parking.replay(
+                conn_id,
+                lambda m, b: self.games.send_by_server_id(target, m, b),
+            )
 
     def _games_tap(self, ev) -> None:
         """Dispatch-tap seam (net/module.py:_Dispatch.tap): stamp arrival
@@ -301,6 +376,45 @@ class ProxyRole(ServerRole):
         self.games.counters.count_relay(msg_id, done - self._relay_arrival_ns)
         self._relay_hist.observe((done - self._relay_arrival_ns) / 1e9)
 
+    def execute(self, now: Optional[float] = None) -> None:
+        now = _time.monotonic() if now is None else now
+        super().execute(now)
+        self._parking_pump(now)
+
+    def _parking_pump(self, now: float) -> None:
+        """Per-pump parking maintenance — strictly non-blocking (lint
+        contract, tests/test_determinism_lint.py): retry replay for
+        sessions whose binding healed without a switch-route (e.g. the
+        origin game revived on the same id), expire deadline-overdue
+        frames, and tell affected clients what was lost."""
+        if self.parking.depth() == 0:
+            return
+        for key in self.parking.keys():
+            info = self._conn_info.get(key)
+            if info is None:
+                self.parking.discard(key)  # client already gone
+                continue
+            gid = info.get("game_id")
+            if gid is None:
+                continue
+            sd = self.games.servers.get(int(gid))
+            if sd is not None and sd.state == NORMAL:
+                self.parking.replay(
+                    key,
+                    lambda m, b, g=int(gid):
+                        self.games.send_by_server_id(g, m, b),
+                )
+        depths = {k: self.parking.depth(k) for k in self.parking.keys()}
+        if self.parking.expire(now):
+            for key, depth in depths.items():
+                if self.parking.depth(key) < depth and isinstance(key, int):
+                    info = self._conn_info.get(key)
+                    gid = (info or {}).get("game_id") or 0
+                    self._notify_switch(
+                        key, SwitchNoticeCode.DROPPED, int(gid),
+                        self.RETRY_AFTER_MS,
+                    )
+
     def report(self):
         r = super().report()
         ext = r.server_info_list_ext
@@ -314,4 +428,6 @@ class ProxyRole(ServerRole):
                 f"{h.percentile(95.0) * 1e3:.4f}".encode())
         ext.key.append(b"traces_relayed")
         ext.value.append(str(self.traces_relayed).encode())
+        ext.key.append(b"parked_frames")
+        ext.value.append(str(self.parking.depth()).encode())
         return r

@@ -19,6 +19,7 @@ import time as _time
 from typing import Dict, List, Optional
 
 from ..defines import LEASE_DOWN_SECONDS, MsgID, ServerState, ServerType
+from ..failover import FailoverDriver, SessionInfo, ext_map
 from ..transport import EV_DISCONNECTED
 from ..wire import (
     AckConnectWorldResult,
@@ -26,8 +27,11 @@ from ..wire import (
     MsgBase,
     ReqConnectWorld,
     RoleOfflineNotify,
+    ServerInfoExt,
     ServerInfoReport,
     ServerInfoReportList,
+    SessionBindNotify,
+    SwitchRefused,
     ident_key as _ident_key,
     unwrap,
     wrap,
@@ -70,7 +74,8 @@ class WorldRole(ServerRole):
     server_type = int(ServerType.WORLD)
 
     def __init__(self, config: RoleConfig, backend: str = "auto",
-                 lease_down_seconds: float = LEASE_DOWN_SECONDS) -> None:
+                 lease_down_seconds: float = LEASE_DOWN_SECONDS,
+                 recover_store=None, failover: bool = True) -> None:
         self.games: Dict[int, _Downstream] = {}
         self.proxies: Dict[int, _Downstream] = {}
         # a downstream that stops reporting for this long is treated as
@@ -79,10 +84,18 @@ class WorldRole(ServerRole):
         # world roster: online player ident -> owning game server id
         # (fed by ACK_ONLINE/OFFLINE_NOTIFY; the reference's OnOnlineProcess)
         self.roster: Dict[tuple, int] = {}
+        # session bind metadata per online player (SESSION_BIND_NOTIFY
+        # sidecars) — everything the failover driver needs to re-home a
+        # session when its game dies (ISSUE 10)
+        self.sessions: Dict[tuple, SessionInfo] = {}
         super().__init__(config, backend=backend)
         self._lease_expirations = self.telemetry.registry.counter(
             "nf_lease_expirations_total",
             "downstream leases aged past the DOWN threshold", ("role",),
+        )
+        self.failover: Optional[FailoverDriver] = (
+            FailoverDriver(self, recover_store=recover_store)
+            if failover else None
         )
         self.master = self.add_upstream(
             "master",
@@ -108,6 +121,9 @@ class WorldRole(ServerRole):
         s.on(MsgID.REQ_SWITCH_SERVER, self._on_switch_relay)
         s.on(MsgID.SWITCH_SERVER_DATA, self._on_switch_relay)
         s.on(MsgID.ACK_SWITCH_SERVER, self._on_switch_relay)
+        # session failover (ISSUE 10): bind metadata + refusal intake
+        s.on(MsgID.SESSION_BIND_NOTIFY, self._on_session_bind)
+        s.on(MsgID.ACK_SWITCH_REFUSED, self._on_switch_refused)
         s.on_socket_event(self._on_socket)
 
     def _on_switch_relay(self, conn_id: int, msg_id: int, body: bytes) -> None:
@@ -122,12 +138,49 @@ class WorldRole(ServerRole):
             int(MsgID.ACK_SWITCH_SERVER): AckSwitchServer,
         }[int(msg_id)]
         _, msg = unwrap(body, cls)
-        sid = (int(msg.self_serverid)
-               if msg_id == int(MsgID.ACK_SWITCH_SERVER)
-               else int(msg.target_serverid))
+        if msg_id == int(MsgID.ACK_SWITCH_SERVER):
+            # a failover-staged switch names a DEAD origin: the driver
+            # (standing in for it) consumes the ack; anything else is a
+            # voluntary switch and relays to the living origin below
+            if self.failover is not None and self.failover.on_ack(msg):
+                return
+            sid = int(msg.self_serverid)
+        else:
+            sid = int(msg.target_serverid)
         d = self.games.get(sid)
         if d is not None:
             self.server.send_raw(d.conn_id, msg_id, body)
+
+    def _on_session_bind(self, conn_id: int, _msg_id: int,
+                         body: bytes) -> None:
+        """Game-side sidecar to ACK_ONLINE_NOTIFY: remember everything
+        needed to re-home this session if its game dies unasked."""
+        _, b = unwrap(body, SessionBindNotify)
+        if b.selfid is None:
+            return
+        client = (_ident_key(b.client_id) if b.client_id is not None
+                  else (0, 0))
+        info = SessionInfo(
+            selfid=_ident_key(b.selfid),
+            account=b.account.decode("utf-8", "replace"),
+            name=b.name.decode("utf-8", "replace"),
+            client_id=client,
+            scene_id=int(b.scene_id),
+            group_id=int(b.group_id),
+            save_key=b.save_key.decode("utf-8", "replace"),
+            game_id=int(b.game_id),
+        )
+        self.sessions[info.selfid] = info
+
+    def _on_switch_refused(self, conn_id: int, _msg_id: int,
+                           body: bytes) -> None:
+        """A staged target could not admit the switch (capacity / torn
+        blob): hand the refusal to the failover driver so it retries
+        another survivor.  Voluntary switches have no refusal leg — the
+        origin's staged blob simply ages out of its TTL sweep."""
+        _, msg = unwrap(body, SwitchRefused)
+        if self.failover is not None:
+            self.failover.on_refused(msg)
 
     # ------------------------------------------- cross-game sync relay
     def _on_cross_sync(self, conn_id: int, msg_id: int, body: bytes) -> None:
@@ -142,6 +195,7 @@ class WorldRole(ServerRole):
                 self.roster[key] = sid
             else:
                 self.roster.pop(key, None)
+                self.sessions.pop(key, None)
         for d in self.games.values():
             if d.conn_id != conn_id:
                 self.server.send_raw(d.conn_id, msg_id, body)
@@ -233,9 +287,12 @@ class WorldRole(ServerRole):
         (CRASH state) and re-push the game list so proxies stop routing
         to the corpse."""
         dead_ids = set()
+        dead_games: Dict[int, _Downstream] = {}
         for d in dead:
             d.report.server_state = int(ServerState.CRASH)
             dead_ids.add(d.report.server_id)
+            if d.report.server_type == int(ServerType.GAME):
+                dead_games[d.report.server_id] = d
             self._relay_report(d.report)
         # synthesize offline notifies for the dead game's players so other
         # games' clients drop their (now frozen) remote mirrors
@@ -248,6 +305,23 @@ class WorldRole(ServerRole):
                 self.server.send_raw(
                     d.conn_id, int(MsgID.ACK_OFFLINE_NOTIFY), body
                 )
+        # supervised failover (ISSUE 10): hand every session bound to a
+        # dead game to the driver, with the durable-media locations the
+        # corpse last advertised (WAL + checkpoint dirs ride its report
+        # ext), so players re-home instead of silently stalling
+        if self.failover is not None and dead_games:
+            now = _time.monotonic()
+            for sid, d in dead_games.items():
+                infos = [v for v in self.sessions.values()
+                         if v.game_id == sid]
+                for v in infos:
+                    self.sessions.pop(v.selfid, None)
+                if infos:
+                    ext = ext_map(d.report)
+                    self.failover.game_died(
+                        sid, infos, ext.get("wal_dir"),
+                        ext.get("ckpt_dir"), now,
+                    )
         self._push_game_list()
 
     # ------------------------------------------------------------ pump
@@ -255,6 +329,28 @@ class WorldRole(ServerRole):
         now = _time.monotonic() if now is None else now
         super().execute(now)
         self._sweep_leases(now)
+        if self.failover is not None:
+            self.failover.execute(now)
+
+    def report(self):
+        """Heartbeat report extended with failover health: pending
+        re-homes + oldest lag ride the ext map so the master can show
+        `failover_pending`/`failover_lag` on /json and the status page."""
+        r = super().report()
+        if self.failover is None:
+            return r
+        ext = r.server_info_list_ext
+        if ext is None:
+            ext = ServerInfoExt()
+            r.server_info_list_ext = ext
+        now = _time.monotonic()
+        for k, v in (
+            ("failover_pending", self.failover.pending_count()),
+            ("failover_lag", round(self.failover.lag(now), 3)),
+        ):
+            ext.key.append(k.encode())
+            ext.value.append(str(v).encode())
+        return r
 
     # ---------------------------------------------- game list to proxies
     def _game_reports(self) -> ServerInfoReportList:

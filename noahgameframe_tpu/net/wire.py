@@ -721,6 +721,54 @@ class RoleOfflineNotify(Message):
     FIELDS = [(1, "guild", Ident, None)]
 
 
+class SwitchNotice(Message):
+    """Proxy → client (msg id ACK_SWITCH_NOTICE): the bound game died.
+    TPU-native — the reference lets orphaned clients time out; we tell
+    them what is happening (re-home in flight / retry later / parked
+    frames dropped).  Codes in :class:`net.defines.SwitchNoticeCode`."""
+
+    FIELDS = [
+        (1, "code", "int32", 0),
+        (2, "target_serverid", "int64", 0),
+        (3, "retry_after_ms", "int64", 0),
+    ]
+
+
+class SessionBindNotify(Message):
+    """Game → world (msg id SESSION_BIND_NOTIFY): sidecar to
+    ACK_ONLINE_NOTIFY carrying the session metadata the world's failover
+    driver needs to re-home this player if the owning game dies without
+    ever being asked — account/name (the durable save identity),
+    client ident (the proxy-side session key), scene/group, and the
+    exact persist key the player's blob lives under."""
+
+    FIELDS = [
+        (1, "selfid", Ident, None),
+        (2, "account", "bytes", b""),
+        (3, "name", "bytes", b""),
+        (4, "client_id", Ident, None),
+        (5, "scene_id", "int64", 0),
+        (6, "group_id", "int64", 0),
+        (7, "save_key", "bytes", b""),
+        (8, "game_id", "int64", 0),
+    ]
+
+
+class SwitchRefused(Message):
+    """Target game → world (msg id ACK_SWITCH_REFUSED): a staged
+    switch-in could not be admitted (capacity, torn blob).  The
+    reference's AckSwitchServer has no failure leg — extending it would
+    break protoc byte-compat — so refusal rides its own message and the
+    failover driver retries a different survivor."""
+
+    FIELDS = [
+        (1, "selfid", Ident, None),
+        (2, "self_serverid", "int64", 0),
+        (3, "target_serverid", "int64", 0),
+        (4, "result", "int32", 0),
+    ]
+
+
 # =====================================================================
 # NFMsgShare.proto equivalents — in-game
 # =====================================================================

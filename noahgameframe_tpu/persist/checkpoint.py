@@ -18,7 +18,7 @@ import json
 import os
 import shutil
 from pathlib import Path
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -112,6 +112,23 @@ def save_world(kernel: Kernel, path: Path, modules=()) -> None:
         shutil.rmtree(old, ignore_errors=True)
     else:
         os.replace(tmp, path)
+
+
+def peek_checkpoint(path) -> Optional[dict]:
+    """Light read-side probe of a checkpoint directory (ISSUE 10): the
+    failover driver wants the recovery basis tick of a DEAD peer's
+    checkpoint without building a kernel or loading arrays.  Returns
+    ``{"tick_count", "array_tick"}`` from meta.json, or None when no
+    complete checkpoint exists (missing dir / torn write in flight)."""
+    meta_path = Path(path) / "meta.json"
+    try:
+        meta = json.loads(meta_path.read_text())
+    except (OSError, ValueError):
+        return None
+    return {
+        "tick_count": int(meta.get("tick_count", 0)),
+        "array_tick": int(meta.get("array_tick", meta.get("tick_count", 0))),
+    }
 
 
 def load_world(kernel: Kernel, path: Path, modules=()) -> None:

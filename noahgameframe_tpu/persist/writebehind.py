@@ -97,6 +97,121 @@ def _segment_index(path: Path) -> int:
     return int(path.stem.split("-", 1)[1])
 
 
+class _TornTail(Exception):
+    """Internal scan signal: a record header/body runs past the end of
+    the segment bytes — the expected crash artifact on the newest
+    segment.  The owning reader truncates it; the read-side peer scan
+    skips it."""
+
+    def __init__(self, off: int, what: str) -> None:
+        super().__init__(what)
+        self.off = off
+        self.what = what
+
+
+def _iter_frames(data: bytes, name: str):
+    """Yield ``(offset, rec_type, body)`` for every complete CRC-checked
+    frame in one segment's bytes.  Raises :class:`_TornTail` when the
+    tail is incomplete, and :class:`WALError` on anything that cannot be
+    a crash artifact (bad magic, unknown record type, impossible length,
+    CRC mismatch on a complete frame)."""
+    if data[: len(WAL_MAGIC)] != WAL_MAGIC:
+        raise WALError(f"{name}: bad segment magic")
+    off = len(WAL_MAGIC)
+    while off < len(data):
+        if off + HEADER.size > len(data):
+            raise _TornTail(off, "torn record header")
+        rec_type, length, crc = HEADER.unpack_from(data, off)
+        if rec_type not in _KNOWN_RECS:
+            raise WALError(f"{name}@{off}: unknown record type {rec_type}")
+        if length > MAX_RECORD_SIZE:
+            raise WALError(f"{name}@{off}: record length {length} "
+                           f"exceeds {MAX_RECORD_SIZE}")
+        if off + HEADER.size + length > len(data):
+            raise _TornTail(off, "torn record body")
+        body = data[off + HEADER.size: off + HEADER.size + length]
+        if zlib.crc32(body) != crc:
+            # a complete frame with a bad CRC is bit damage, not a
+            # crash artifact — fail closed like the journal reader
+            raise WALError(f"{name}@{off}: CRC mismatch")
+        yield off, rec_type, body
+        off += HEADER.size + length
+
+
+class PeerWALView:
+    """Read-only recovery view over a (possibly dead) pipeline's WAL
+    directory — what :func:`read_peer_wal` returns."""
+
+    __slots__ = ("pending", "flushed_seq", "flushed_tick", "max_tick",
+                 "pending_batches", "torn_tail_skipped")
+
+    def __init__(self, pending: Dict[str, Optional[bytes]],
+                 flushed_seq: int, flushed_tick: int, max_tick: int,
+                 pending_batches: int, torn_tail_skipped: int) -> None:
+        self.pending = pending
+        self.flushed_seq = int(flushed_seq)
+        self.flushed_tick = int(flushed_tick)
+        self.max_tick = int(max_tick)
+        self.pending_batches = int(pending_batches)
+        self.torn_tail_skipped = int(torn_tail_skipped)
+
+
+def read_peer_wal(wal_dir) -> PeerWALView:
+    """Read-side recovery over a PEER's WAL directory (ISSUE 10).
+
+    The world's failover driver reconstructs a dead game's player blobs
+    from the newest durable (checkpoint, WAL suffix) pair without taking
+    ownership of the directory.  Unlike :class:`StagingWAL` construction
+    this NEVER mutates the directory: a torn tail on the newest segment
+    is skipped in memory, not truncated in place — the owner may later
+    be revived over the same directory and must find its crash artifact
+    exactly where it left it.  Corruption anywhere else raises
+    :class:`WALError`, same as the owning reader.
+
+    ``pending`` holds the newest value per key across every batch past
+    the flushed watermark, applied in seq order (tombstones stay as
+    ``None`` entries so callers can distinguish "deleted after the last
+    flush" from "never staged").  An empty/missing directory yields an
+    empty view — the store is then the only durable source.
+    """
+    path = Path(wal_dir)
+    by_seq: Dict[int, Batch] = {}
+    flushed_seq = 0
+    flushed_tick = 0
+    torn_skipped = 0
+    segments = (sorted(path.glob(WAL_GLOB), key=_segment_index)
+                if path.is_dir() else [])
+    for i, seg in enumerate(segments):
+        newest = i == len(segments) - 1
+        try:
+            for _off, rec_type, body in _iter_frames(seg.read_bytes(),
+                                                     seg.name):
+                if rec_type == WB_BATCH:
+                    b = decode_batch(body)
+                    by_seq[b.seq] = b
+                elif rec_type == WB_MARK:
+                    seq, tick = MARK_BODY.unpack(body)
+                    if seq > flushed_seq:
+                        flushed_seq, flushed_tick = seq, tick
+        except _TornTail as torn:
+            if not newest:
+                raise WALError(
+                    f"{seg.name}@{torn.off}: {torn.what} in closed segment"
+                ) from torn
+            torn_skipped += 1
+    pending: Dict[str, Optional[bytes]] = {}
+    max_tick = flushed_tick
+    pending_batches = 0
+    for b in sorted(by_seq.values(), key=lambda b: b.seq):
+        if b.seq <= flushed_seq:
+            continue
+        pending.update(b.entries)
+        max_tick = max(max_tick, b.tick)
+        pending_batches += 1
+    return PeerWALView(pending, flushed_seq, flushed_tick, max_tick,
+                       pending_batches, torn_skipped)
+
+
 class Batch:
     """One tick-watermarked, key-coalesced unit of durability.
 
@@ -214,39 +329,20 @@ class StagingWAL:
 
     def _scan_segment(self, seg: Path, newest: bool,
                       by_seq: Dict[int, Batch]) -> int:
-        data = seg.read_bytes()
-        if data[: len(WAL_MAGIC)] != WAL_MAGIC:
-            raise WALError(f"{seg.name}: bad segment magic")
-        off = len(WAL_MAGIC)
         max_seq = -1
-        while off < len(data):
-            if off + HEADER.size > len(data):
-                off = self._torn(seg, newest, off, "torn record header")
-                break
-            rec_type, length, crc = HEADER.unpack_from(data, off)
-            if rec_type not in _KNOWN_RECS:
-                raise WALError(f"{seg.name}@{off}: unknown record type "
-                               f"{rec_type}")
-            if length > MAX_RECORD_SIZE:
-                raise WALError(f"{seg.name}@{off}: record length {length} "
-                               f"exceeds {MAX_RECORD_SIZE}")
-            if off + HEADER.size + length > len(data):
-                off = self._torn(seg, newest, off, "torn record body")
-                break
-            body = data[off + HEADER.size: off + HEADER.size + length]
-            if zlib.crc32(body) != crc:
-                # a complete frame with a bad CRC is bit damage, not a
-                # crash artifact — fail closed like the journal reader
-                raise WALError(f"{seg.name}@{off}: CRC mismatch")
-            if rec_type == WB_BATCH:
-                b = decode_batch(body)
-                by_seq[b.seq] = b
-                max_seq = max(max_seq, b.seq)
-            elif rec_type == WB_MARK:
-                seq, tick = MARK_BODY.unpack(body)
-                if seq > self.flushed_seq:
-                    self.flushed_seq, self.flushed_tick = seq, tick
-            off += HEADER.size + length
+        try:
+            for _off, rec_type, body in _iter_frames(seg.read_bytes(),
+                                                     seg.name):
+                if rec_type == WB_BATCH:
+                    b = decode_batch(body)
+                    by_seq[b.seq] = b
+                    max_seq = max(max_seq, b.seq)
+                elif rec_type == WB_MARK:
+                    seq, tick = MARK_BODY.unpack(body)
+                    if seq > self.flushed_seq:
+                        self.flushed_seq, self.flushed_tick = seq, tick
+        except _TornTail as torn:
+            self._torn(seg, newest, torn.off, torn.what)
         return max_seq
 
     def _torn(self, seg: Path, newest: bool, off: int, what: str) -> int:

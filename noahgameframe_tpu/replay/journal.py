@@ -124,6 +124,12 @@ class JournalWriter:
         self._append(REC_META, json.dumps(
             {"segment": self._seg_index, "after_tick": self.last_tick}
         ).encode())
+        # push the header past the userspace buffer right away: open()
+        # already created the file, so without this a concurrent reader
+        # (live digest checks, the failover smoke) sees an EMPTY segment
+        # and calls it corrupt — fail-closed readers need the magic on
+        # disk the moment the segment is observable
+        self._file.flush()
 
     def _append(self, rec_type: int, body: bytes) -> None:
         if self._file is None:

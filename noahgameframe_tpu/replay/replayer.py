@@ -136,6 +136,13 @@ def replay_journal(
             world_factory() if world_factory is not None else None
         )
     k = role.kernel
+    # the recorded role pinned its guid allocator at journal setup (the
+    # seed is in the meta); pin ours to the same point so every replayed
+    # create mints the exact recorded guid — handlers keyed by
+    # wire-carried guids (the switch ack's destroy) depend on it
+    guid_seed = reader.meta.get("guid_seed")
+    if guid_seed is not None:
+        k.store.guids.pin(int(guid_seed))
     k.enable_digest()
     if checkpoint is not None and (Path(checkpoint) / "meta.json").exists():
         role.game_world.load(checkpoint)

@@ -290,7 +290,11 @@ def run(tmpdir, seed: int = 7) -> dict:
         )
 
         # ---- journal digest stream (both runs' records, the revived
-        # run overwriting the overlap) matches the control everywhere
+        # run overwriting the overlap) matches the control everywhere.
+        # The revived writer is still OPEN: sync it first, or the strict
+        # reader sees its buffered tail as a torn segment.
+        if revived.journal is not None:
+            revived.journal.sync()
         recorded = read_ticks(jdir)
         overlap = [t for t in recorded if t in digests]
         checks["journal digest stream matches control"] = (

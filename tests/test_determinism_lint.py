@@ -39,6 +39,11 @@ EXTRA_FILES = (
     "net/roles/game.py",
     "net/roles/proxy.py",
     "client/sdk.py",
+    # session failover (ISSUE 10): park/replay decisions are journaled
+    # inputs downstream (the frames they order feed game handlers), and
+    # the driver's retry/deadline arithmetic runs on injected `now` —
+    # a wall clock here would make re-homes non-reproducible
+    "net/failover.py",
 )
 
 
@@ -262,6 +267,59 @@ def _journal_tap_fn():
                  and n.name == "_journal_tap")
     return next(n for n in ast.walk(outer)
                 if isinstance(n, ast.FunctionDef) and n.name == "tap")
+
+
+def _class_methods(path: Path, class_name: str):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    cls = next(n for n in tree.body
+               if isinstance(n, ast.ClassDef) and n.name == class_name)
+    return {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
+
+
+# --- parking-path thread contract (ISSUE 10): the proxy parks, replays
+# and expires client frames on its dispatch/pump thread — while every
+# OTHER client's traffic waits behind it.  A sleep, a blocking file or
+# store call, or an unbounded busy loop there turns one session's
+# failover stall into a whole-proxy stall.  Enforced structurally, like
+# the write-behind pump surface above.
+FAILOVER_PATH = PKG / "net" / "failover.py"
+PROXY_PATH = PKG / "net" / "roles" / "proxy.py"
+PARKING_METHODS = {"park", "expire", "replay", "discard", "depth", "keys"}
+PROXY_PARKING_SURFACE = {"_parking_pump", "_on_client_message",
+                         "_on_switch_route", "_notify_switch"}
+_BLOCKING = ("sleep", "fsync", "open", "connect", "recv", "accept")
+
+
+def _blocking_calls(fn):
+    for line, dotted in _calls(fn):
+        leaf = dotted.rsplit(".", 1)[-1]
+        if leaf in _BLOCKING:
+            yield f"{fn.name}:{line}: {dotted}"
+
+
+def test_parking_buffer_declares_expected_surface():
+    missing = PARKING_METHODS - set(_class_methods(FAILOVER_PATH,
+                                                   "ParkingBuffer"))
+    assert not missing, f"parking methods vanished: {sorted(missing)}"
+
+
+@pytest.mark.parametrize("method", sorted(PARKING_METHODS))
+def test_parking_buffer_never_blocks(method):
+    fn = _class_methods(FAILOVER_PATH, "ParkingBuffer")[method]
+    offenses = list(_blocking_calls(fn))
+    assert not offenses, (
+        "blocking call inside ParkingBuffer:\n" + "\n".join(offenses)
+    )
+
+
+@pytest.mark.parametrize("method", sorted(PROXY_PARKING_SURFACE))
+def test_proxy_parking_pump_never_blocks(method):
+    methods = _class_methods(PROXY_PATH, "ProxyRole")
+    assert method in methods, f"proxy parking surface lost {method}"
+    offenses = list(_blocking_calls(methods[method]))
+    assert not offenses, (
+        "blocking call on the proxy parking path:\n" + "\n".join(offenses)
+    )
 
 
 def test_journal_tap_excludes_trace_sidecars():

@@ -1,0 +1,375 @@
+"""Supervised session failover (ISSUE 10).
+
+Covers the re-home stack bottom-up: the proxy's ParkingBuffer drop
+disciplines (overflow oldest-drop vs deadline-drop, partial replay on a
+flapping binding, disconnect discard), the world's FailoverDriver
+placement/retry/ack state machine (BUSY with no survivor, refusal
+re-placement, duplicate ACK_SWITCH_SERVER, deadline give-up), the game
+side's switch-in hardening (torn SWITCH_SERVER_DATA blobs, capacity
+refusal, idempotent duplicate REQ, duplicate ack tolerance),
+ChaosDirector.heal (the failover-drill primitive), and — via
+scripts/failover_smoke.py — the full kill-a-game-mid-combat e2e.
+"""
+
+import importlib.util
+import sys
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from noahgameframe_tpu.net.chaos import (
+    ChaosDirector,
+    FaultPlan,
+    LinkFaults,
+)
+from noahgameframe_tpu.net.defines import MsgID, ServerState
+from noahgameframe_tpu.net.failover import (
+    REFUSE_BAD_BLOB,
+    REFUSE_BUSY,
+    FailoverDriver,
+    ParkingBuffer,
+    SessionInfo,
+)
+from noahgameframe_tpu.net.wire import (
+    AckSwitchServer,
+    Ident,
+    ReqSwitchServer,
+    SwitchRefused,
+    SwitchServerData,
+    ident_key,
+    unwrap,
+    wrap,
+)
+from noahgameframe_tpu.telemetry.registry import MetricsRegistry
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "scripts" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------- parking
+
+def test_parking_overflow_drops_oldest():
+    pb = ParkingBuffer(max_frames=3, deadline_s=60.0)
+    dropped = 0
+    for i in range(5):
+        dropped += pb.park("c1", 100 + i, bytes([i]), now=float(i))
+    assert dropped == 2
+    assert pb.dropped_overflow == 2
+    assert pb.depth("c1") == 3
+    # the survivors are the NEWEST three, still in arrival order
+    out = []
+    pb.replay("c1", lambda mid, body: out.append((mid, body)) or True)
+    assert out == [(102, b"\x02"), (103, b"\x03"), (104, b"\x04")]
+
+
+def test_parking_deadline_drop_is_per_frame_age():
+    pb = ParkingBuffer(max_frames=16, deadline_s=10.0)
+    pb.park("c1", 1, b"a", now=0.0)
+    pb.park("c1", 2, b"b", now=5.0)
+    assert pb.expire(now=9.9) == 0
+    assert pb.expire(now=10.0) == 1  # only the first frame aged out
+    assert pb.depth("c1") == 1
+    assert pb.expire(now=15.0) == 1
+    assert pb.dropped_deadline == 2
+    assert pb.depth() == 0
+    assert pb.keys() == []  # empty queues are removed, not leaked
+
+
+def test_parking_replay_stops_at_failed_send_then_resumes():
+    # the out-of-order-ack shape: the new binding acks, replay starts,
+    # the link flaps mid-replay — the tail must stay parked IN ORDER
+    # and drain on the next pump, never reorder or drop
+    pb = ParkingBuffer(max_frames=16, deadline_s=60.0)
+    for i in range(4):
+        pb.park("c1", 200 + i, bytes([i]), now=0.0)
+    sent = []
+
+    def flaky(mid, body):
+        if len(sent) >= 2:
+            return False
+        sent.append(mid)
+        return True
+
+    n, drained = pb.replay("c1", flaky)
+    assert (n, drained) == (2, False)
+    assert pb.depth("c1") == 2
+    n, drained = pb.replay("c1", lambda mid, body: sent.append(mid) or True)
+    assert (n, drained) == (2, True)
+    assert sent == [200, 201, 202, 203]
+    assert pb.replayed_total == 4
+    assert pb.dropped_total == 0
+
+
+def test_parking_discard_and_counters():
+    reg = MetricsRegistry()
+    pb = ParkingBuffer(max_frames=2, deadline_s=10.0, registry=reg)
+    for i in range(4):
+        pb.park("c1", i, b"x", now=0.0)
+    pb.park("c2", 9, b"y", now=0.0)
+    assert pb.discard("c1") == 2
+    pb.expire(now=10.0)
+    assert pb.dropped_overflow == 2
+    assert pb.dropped_disconnect == 2
+    assert pb.dropped_deadline == 1
+    assert pb.dropped_total == 5
+    assert reg.value("nf_failover_parked_frames_total") == 5.0
+    assert reg.value("nf_failover_dropped_total", reason="overflow") == 2.0
+    assert reg.value("nf_failover_dropped_total", reason="disconnect") == 2.0
+    assert reg.value("nf_failover_dropped_total", reason="deadline") == 1.0
+
+
+# ---------------------------------------------------------------- driver
+
+def _fake_game(conn_id, cur=0, cap=8, state=ServerState.NORMAL):
+    return SimpleNamespace(
+        conn_id=conn_id,
+        report=SimpleNamespace(
+            server_state=int(state),
+            server_cur_count=int(cur),
+            server_max_online=int(cap),
+        ),
+    )
+
+
+class _FakeWorld:
+    def __init__(self, games):
+        self.games = games
+        self.telemetry = SimpleNamespace(registry=MetricsRegistry())
+        self.sent = []
+        self.server = SimpleNamespace(
+            send_raw=lambda conn, mid, body: (
+                self.sent.append((conn, mid, body)), True
+            )[1]
+        )
+
+
+def _info(selfid=(1, 100), game_id=6):
+    return SessionInfo(
+        selfid=selfid, account="ada", name="Ada", client_id=(5, 7),
+        scene_id=1, group_id=1, save_key="", game_id=game_id,
+    )
+
+
+def test_driver_stages_data_then_req_and_consumes_ack_once():
+    world = _FakeWorld({16: _fake_game(conn_id=42)})
+    drv = FailoverDriver(world)
+    drv.game_died(6, [_info()], None, None, now=0.0)
+    assert drv.pending_count() == 1
+    # DATA then REQ on the SAME conn, in that order — the no-reorder
+    # guarantee the switch-in path depends on
+    assert [(c, m) for c, m, _ in world.sent] == [
+        (42, int(MsgID.SWITCH_SERVER_DATA)),
+        (42, int(MsgID.REQ_SWITCH_SERVER)),
+    ]
+    _, data = unwrap(world.sent[0][2], SwitchServerData)
+    assert int(data.target_serverid) == 16
+    reg = world.telemetry.registry
+    assert reg.value("nf_failover_initiated_total") == 1.0
+
+    ack = AckSwitchServer(selfid=Ident(svrid=1, index=100),
+                          self_serverid=6, target_serverid=16)
+    assert drv.on_ack(ack) is True
+    assert drv.pending_count() == 0
+    assert drv.completed[-1]["to"] == 16
+    # duplicate ACK_SWITCH_SERVER (dup'd link): already consumed — the
+    # caller must treat it as a voluntary-switch relay, not re-complete
+    assert drv.on_ack(ack) is False
+    assert reg.value("nf_failover_completed_total") == 1.0
+
+
+def test_driver_busy_when_no_survivor_then_places_on_free_capacity():
+    world = _FakeWorld({16: _fake_game(conn_id=42, cur=8, cap=8)})
+    drv = FailoverDriver(world, retry_s=0.5)
+    drv.game_died(6, [_info()], None, None, now=0.0)
+    assert world.sent == []  # nothing stageable — explicit BUSY, no sends
+    assert drv.pending_count() == 1
+    assert world.telemetry.registry.value("nf_failover_busy_total") >= 1.0
+    # a player logs out of the survivor: the next pump places the refugee
+    world.games[16].report.server_cur_count = 7
+    drv.execute(now=1.0)
+    assert [m for _, m, _ in world.sent] == [
+        int(MsgID.SWITCH_SERVER_DATA), int(MsgID.REQ_SWITCH_SERVER),
+    ]
+
+
+def test_driver_refusal_excludes_target_and_retries_elsewhere():
+    import time as _time
+
+    world = _FakeWorld({
+        16: _fake_game(conn_id=42, cur=0),
+        26: _fake_game(conn_id=43, cur=5),
+    })
+    # on_refused stamps next_try with the real monotonic clock, so this
+    # test drives the driver on that clock (large deadline: no expiry)
+    drv = FailoverDriver(world, deadline_s=3600.0)
+    drv.game_died(6, [_info()], None, None, now=_time.monotonic())
+    assert world.sent[0][0] == 42  # least-loaded survivor first
+    world.sent.clear()
+    refused = SwitchRefused(selfid=Ident(svrid=1, index=100),
+                            self_serverid=6, target_serverid=16,
+                            result=REFUSE_BUSY)
+    assert drv.on_refused(refused) is True
+    drv.execute(now=_time.monotonic() + 0.01)
+    assert drv.pending_count() == 1
+    assert world.sent and world.sent[0][0] == 43  # the other survivor
+
+
+def test_driver_gives_up_at_deadline():
+    world = _FakeWorld({16: _fake_game(conn_id=42, cur=8, cap=8)})
+    drv = FailoverDriver(world, deadline_s=1.0)
+    drv.game_died(6, [_info()], None, None, now=0.0)
+    assert drv.pending_count() == 1
+    assert drv.lag(0.5) == 0.5
+    drv.execute(now=2.0)
+    assert drv.pending_count() == 0
+    reg = world.telemetry.registry
+    assert reg.value("nf_failover_deadline_exceeded_total") == 1.0
+    assert reg.value("nf_failover_pending") == 0.0
+
+
+# ----------------------------------------------------- game switch-in
+
+@pytest.fixture(scope="module")
+def offline_role():
+    from noahgameframe_tpu.replay.replayer import make_offline_role
+
+    return make_offline_role()
+
+
+def _capture_world_sends(role):
+    sent = []
+    role.world_link.send_to_all = (
+        lambda mid, body: sent.append((mid, body)) or True
+    )
+    return sent
+
+
+def _switch_msgs(selfid, target, client=None):
+    data = SwitchServerData(
+        selfid=selfid, account=b"ada", name=b"Ada", blob=b"",
+        target_serverid=int(target),
+    )
+    req = ReqSwitchServer(
+        selfid=selfid, self_serverid=99, target_serverid=int(target),
+        gate_serverid=0, scene_id=1,
+        client_id=client or Ident(svrid=5, index=7), group_id=1,
+    )
+    return data, req
+
+
+def test_switch_in_refuses_torn_blob(offline_role):
+    role = offline_role
+    sent = _capture_world_sends(role)
+    selfid = Ident(svrid=9, index=1111)
+    data, req = _switch_msgs(selfid, role.config.server_id)
+    data.blob = b"\xff\xfe\xfd not a snapshot \x00\x01"
+    before = role.kernel.store.live_count("Player")
+    role._on_switch_data(0, int(MsgID.SWITCH_SERVER_DATA), wrap(data))
+    role._on_switch_in(0, int(MsgID.REQ_SWITCH_SERVER), wrap(req))
+    refusals = [b for m, b in sent if m == int(MsgID.ACK_SWITCH_REFUSED)]
+    assert len(refusals) == 1
+    _, msg = unwrap(refusals[0], SwitchRefused)
+    assert int(msg.result) == REFUSE_BAD_BLOB
+    assert int(msg.target_serverid) == role.config.server_id
+    # the half-built object was destroyed — nothing half-applied admitted
+    assert role.kernel.store.live_count("Player") == before
+    assert not any(m == int(MsgID.ACK_SWITCH_SERVER) for m, _ in sent)
+
+
+def test_switch_in_refuses_at_capacity(offline_role):
+    role = offline_role
+    sent = _capture_world_sends(role)
+    store = role.kernel.store
+    cap = store.capacity("Player")
+    store.live_count = lambda cname: cap  # shadow: store reads full
+    try:
+        selfid = Ident(svrid=9, index=2222)
+        data, req = _switch_msgs(selfid, role.config.server_id,
+                                 client=Ident(svrid=5, index=8))
+        role._on_switch_data(0, int(MsgID.SWITCH_SERVER_DATA), wrap(data))
+        role._on_switch_in(0, int(MsgID.REQ_SWITCH_SERVER), wrap(req))
+    finally:
+        del store.live_count  # un-shadow the real method
+    refusals = [b for m, b in sent if m == int(MsgID.ACK_SWITCH_REFUSED)]
+    assert len(refusals) == 1
+    _, msg = unwrap(refusals[0], SwitchRefused)
+    assert int(msg.result) == REFUSE_BUSY
+
+
+def test_switch_in_admits_then_tolerates_duplicate_req_and_ack(offline_role):
+    role = offline_role
+    sent = _capture_world_sends(role)
+    selfid = Ident(svrid=9, index=3333)
+    client = Ident(svrid=5, index=9)
+    data, req = _switch_msgs(selfid, role.config.server_id, client=client)
+    before = role.kernel.store.live_count("Player")
+    role._on_switch_data(0, int(MsgID.SWITCH_SERVER_DATA), wrap(data))
+    role._on_switch_in(0, int(MsgID.REQ_SWITCH_SERVER), wrap(req))
+    acks = [b for m, b in sent if m == int(MsgID.ACK_SWITCH_SERVER)]
+    assert len(acks) == 1
+    assert role.kernel.store.live_count("Player") == before + 1
+    sess = role.sessions[ident_key(client)]
+    guid = sess.guid
+    assert guid is not None
+
+    # duplicate REQ (the staged blob is gone): re-ack idempotently, do
+    # NOT create a second avatar — the world-side driver may have lost
+    # the first ack to a dropped link
+    role._on_switch_in(0, int(MsgID.REQ_SWITCH_SERVER), wrap(req))
+    acks = [b for m, b in sent if m == int(MsgID.ACK_SWITCH_SERVER)]
+    assert len(acks) == 2
+    assert role.kernel.store.live_count("Player") == before + 1
+
+    # origin-side ack: this game hands the player off — object destroyed,
+    # binding dropped; a dup'd second ack must be a clean no-op
+    ack = AckSwitchServer(
+        selfid=Ident(svrid=guid.head, index=guid.data),
+        self_serverid=role.config.server_id, target_serverid=77,
+    )
+    role._on_switch_ack(0, int(MsgID.ACK_SWITCH_SERVER), wrap(ack))
+    assert guid not in role.kernel.store.guid_map
+    assert role.sessions.get(ident_key(client)) is None
+    role._on_switch_ack(0, int(MsgID.ACK_SWITCH_SERVER), wrap(ack))
+    assert guid not in role.kernel.store.guid_map
+
+
+# ------------------------------------------------------------ chaos heal
+
+def test_chaos_heal_flips_live_wrappers_and_future_dials():
+    plan = FaultPlan(seed=3, links={"proxy5.games": LinkFaults(drop=1.0)})
+    director = ChaosDirector(plan)
+    w = director.wrap(SimpleNamespace(), "proxy5.games->6")
+    assert w.faults.drop == 1.0
+    assert director.heal("proxy5.games") == 1
+    assert w.faults.drop == 0.0  # live wrapper healed in place
+    # a reconnect's fresh wrapper re-reads the healed plan
+    w2 = director.wrap(SimpleNamespace(), "proxy5.games->6")
+    assert w2.faults.drop == 0.0
+    # counts survive healing (the drill still wants the fault ledger)
+    assert "proxy5.games->6" in director.counts
+
+
+# ------------------------------------------------------------------ e2e
+
+def test_failover_smoke_e2e(tmp_path):
+    smoke = _load_script("failover_smoke")
+    checks = smoke.run(tmp_path)
+    failed = [k for k, ok in checks.items() if not ok]
+    assert not failed, f"failover smoke failed: {failed}"
+
+
+def test_handoff_surge_replays_clean(tmp_path):
+    smoke = _load_script("failover_smoke")
+    checks = smoke.surge(tmp_path, rounds=6)
+    failed = [k for k, ok in checks.items() if not ok]
+    assert not failed, f"handoff surge failed: {failed}"

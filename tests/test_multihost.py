@@ -6,6 +6,8 @@ import threading
 import time
 import urllib.request
 
+import pytest
+
 from noahgameframe_tpu.net.roles.base import RoleConfig
 from noahgameframe_tpu.net.roles.master import MasterRole
 from noahgameframe_tpu.parallel import (
@@ -116,6 +118,21 @@ def test_two_process_distributed_tick():
             for q in procs:
                 q.kill()
             raise
+        if (p.returncode != 0
+                and "aren't implemented on the CPU backend" in err):
+            # Tracking note (ISSUE 10 satellite): jax's CPU backend
+            # cannot run multiprocess collectives in this jaxlib build
+            # (XlaRuntimeError: INVALID_ARGUMENT: Multiprocess
+            # computations aren't implemented on the CPU backend), so
+            # the real two-process tick is unreachable here.  xfail
+            # keeps the test armed: on a TPU/GPU host — or a jaxlib
+            # with CPU gloo collectives — it runs for real again.
+            for q in procs:
+                q.kill()
+            pytest.xfail(
+                "multiprocess collectives unsupported on the CPU "
+                "backend of this jaxlib build"
+            )
         assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
         line = [ln for ln in out.strip().splitlines()
                 if ln.startswith("{")][-1]
