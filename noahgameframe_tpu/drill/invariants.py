@@ -1,0 +1,322 @@
+"""Cluster invariants sampled every drill pump.
+
+Each checker is a small stateful object: :meth:`Invariant.check` gets a
+:class:`DrillContext` (cluster + drill tick + pump timestamp) and
+returns a list of human-readable violation details — empty when the
+invariant holds.  Checkers keep their own baselines (previous lease map,
+previous watermarks, unbound-session streaks) so a single runner
+instance observes *transitions*, not just states.
+
+The library (ISSUE 11 tentpole):
+
+- :class:`NoSilentDrop` — every session that loses its game binding
+  hears about it (a REHOMING/BUSY/DROPPED notice); dropped parked
+  frames are never silent.
+- :class:`LegalLeaseTransitions` — master lease strings only move along
+  UP→SUSPECT→DOWN (plus recovery back to UP); no teleporting.
+- :class:`MonotoneWatermarks` — WAL flush watermarks never move
+  backwards per store key, across kills, revives, and outages.
+- :class:`BoundedFailoverLag` — the oldest pending re-home never
+  outlives ``NF_FAILOVER_DEADLINE_S`` (+ slack for the pump quantum).
+- :class:`OrderedReplay` — parked-frame replay preserves per-session
+  arrival order (fed by :class:`net.failover.ParkingBuffer`'s seq
+  audit).
+- :class:`ConsistentCounters` — the failover/parking telemetry bank is
+  conserved: ``initiated == completed + deadline_exceeded + pending``
+  and ``parked == replayed + dropped + still-parked``.  (ISSUE 11
+  phrases the first identity with ``busy``, but ``nf_failover_busy_
+  total`` counts placement *rounds*, not sessions — the conserved
+  session-count identity uses the pending gauge; busy is separately
+  required to be monotone.)
+
+Checkers read cluster state defensively (``getattr`` with fallbacks) so
+violation tests can feed them minimal forged stand-ins.
+
+This module is tick-indexed like the schedules: it must not reference
+the ``time`` module (structural lint in tests/test_determinism_lint.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..net.defines import SwitchNoticeCode
+
+
+@dataclasses.dataclass(frozen=True)
+class DrillContext:
+    """What a checker sees each sample: the cluster under drill, the
+    drill tick, and the pump pass's monotonic timestamp (taken once by
+    the runner so every checker in a sample shares one clock read)."""
+
+    cluster: object
+    tick: int
+    now: float
+
+
+class Invariant:
+    """Base checker; subclasses set ``name`` and implement ``check``."""
+
+    name = "invariant"
+
+    def check(self, ctx: DrillContext) -> List[str]:
+        raise NotImplementedError
+
+
+class NoSilentDrop(Invariant):
+    """No session is ever silently dropped.
+
+    Two clauses, both over the proxy edge:
+
+    1. If any parked frames were dropped for a *live* client (overflow
+       or deadline — disconnect drops have no receiver to notify), at
+       least one DROPPED notice must have been pushed.
+    2. A client whose bound game has vanished from the proxy's routed
+       set for ``grace_samples`` consecutive samples must have received
+       at least one switch notice (REHOMING/BUSY/DROPPED).  The grace
+       covers the push-ordering window between the world's game-list
+       update and the notice fan-out.
+    """
+
+    name = "no_silent_drop"
+
+    def __init__(self, grace_samples: int = 25) -> None:
+        self.grace_samples = max(1, int(grace_samples))
+        self._streak: Dict[object, int] = {}
+
+    def check(self, ctx: DrillContext) -> List[str]:
+        proxy = ctx.cluster.proxy
+        out: List[str] = []
+        parking = proxy.parking
+        loud_drops = (int(parking.dropped_overflow)
+                      + int(parking.dropped_deadline))
+        notices = getattr(proxy, "notice_counts", {})
+        if loud_drops and not notices.get(int(SwitchNoticeCode.DROPPED), 0):
+            out.append(f"{loud_drops} parked frames dropped with zero "
+                       "DROPPED notices sent")
+        live = set(getattr(proxy.games, "servers", {}))
+        per_conn = getattr(proxy, "conn_notices", {})
+        for conn_id, info in dict(proxy._conn_info).items():
+            gid = info.get("game_id")
+            if gid is None or int(gid) in live:
+                self._streak.pop(conn_id, None)
+                continue
+            streak = self._streak.get(conn_id, 0) + 1
+            self._streak[conn_id] = streak
+            if streak >= self.grace_samples and not per_conn.get(conn_id):
+                out.append(
+                    f"conn {conn_id} unbound from dead game {gid} for "
+                    f"{streak} samples with no switch notice"
+                )
+        return out
+
+
+class LegalLeaseTransitions(Invariant):
+    """Master lease strings move only along the legal machine:
+    UP→SUSPECT, SUSPECT→DOWN, and recovery SUSPECT→UP / DOWN→UP.
+
+    UP→DOWN is tolerated only when a recent inter-sample gap exceeds
+    the SUSPECT window itself (the pump, not the state machine, stalled
+    through the intermediate state).  The *two* most recent gaps are
+    considered: the master sweeps at the top of a pump pass and we
+    sample at the bottom, so a stall late in pass N (inside our
+    N-1→N gap) surfaces as a lease jump at sweep N+1 — one sample
+    after the gap that explains it."""
+
+    name = "legal_lease_transitions"
+    LEGAL = {("UP", "SUSPECT"), ("SUSPECT", "DOWN"),
+             ("SUSPECT", "UP"), ("DOWN", "UP")}
+
+    def __init__(self) -> None:
+        self._prev: Dict[Tuple[int, int], str] = {}
+        self._prev_now: Optional[float] = None
+        self._prev_gap = 0.0
+
+    def check(self, ctx: DrillContext) -> List[str]:
+        master = ctx.cluster.master
+        out: List[str] = []
+        suspect_window = max(
+            0.0,
+            float(getattr(master, "lease_down_seconds", 0.0))
+            - float(getattr(master, "lease_suspect_seconds", 0.0)),
+        )
+        gap = (ctx.now - self._prev_now
+               if self._prev_now is not None else 0.0)
+        coarse = suspect_window > 0.0 and max(gap, self._prev_gap) > suspect_window
+        for stype, by_id in master.registry.items():
+            for sid, reg in by_id.items():
+                key = (int(stype), int(sid))
+                cur = str(reg.lease)
+                prev = self._prev.get(key)
+                self._prev[key] = cur
+                if prev is None or prev == cur:
+                    continue
+                if (prev, cur) in self.LEGAL:
+                    continue
+                if (prev, cur) == ("UP", "DOWN") and coarse:
+                    continue  # sampler skipped SUSPECT, machine did not
+                out.append(f"server type={stype} id={sid} lease jumped "
+                           f"{prev}->{cur}")
+        self._prev_gap = gap
+        self._prev_now = ctx.now
+        return out
+
+
+class MonotoneWatermarks(Invariant):
+    """WAL flush watermarks never move backwards per store key.
+
+    Default probe: every live game role's write-behind pipeline
+    (``wal:<name>`` → its WAL's ``(flushed_seq, flushed_tick)``).  An
+    optional ``store_probe`` adds store-side keys (e.g. the
+    ``__wb__:<name>`` watermark blobs in the shared KV) so the check
+    spans the full staging→flush path.
+
+    Keys are allowed to *disappear* (a killed role) — the baseline is
+    kept, so a revived pipeline that restarts below its old watermark
+    is caught the moment it reports again."""
+
+    name = "monotone_watermarks"
+
+    def __init__(self, store_probe: Optional[
+            Callable[[], Dict[str, Tuple[int, int]]]] = None) -> None:
+        self.store_probe = store_probe
+        self._prev: Dict[str, Tuple[int, int]] = {}
+
+    def _marks(self, ctx: DrillContext) -> Dict[str, Tuple[int, int]]:
+        marks: Dict[str, Tuple[int, int]] = {}
+        for game in list(getattr(ctx.cluster, "games", ())):
+            pipeline = getattr(game, "persist", None)
+            if pipeline is None:
+                continue
+            marks[f"wal:{pipeline.name}"] = (
+                int(pipeline.wal.flushed_seq),
+                int(pipeline.wal.flushed_tick),
+            )
+        if self.store_probe is not None:
+            for key, mark in self.store_probe().items():
+                marks[str(key)] = (int(mark[0]), int(mark[1]))
+        return marks
+
+    def check(self, ctx: DrillContext) -> List[str]:
+        out: List[str] = []
+        for key, (seq, tick) in self._marks(ctx).items():
+            pseq, ptick = self._prev.get(key, (-1, -1))
+            if seq < pseq or (seq == pseq and tick < ptick):
+                out.append(f"watermark {key} moved backwards: "
+                           f"{pseq}:{ptick} -> {seq}:{tick}")
+            else:
+                self._prev[key] = (seq, tick)
+        return out
+
+
+class BoundedFailoverLag(Invariant):
+    """The oldest pending re-home never outlives the failover deadline
+    (+ slack for the pump quantum: the driver expires at deadline on its
+    next pump, so lag can legitimately overshoot by one pass)."""
+
+    name = "bounded_failover_lag"
+
+    def __init__(self, slack_s: float = 1.0) -> None:
+        self.slack_s = float(slack_s)
+
+    def check(self, ctx: DrillContext) -> List[str]:
+        driver = getattr(ctx.cluster.world, "failover", None)
+        if driver is None:
+            return []
+        lag = float(driver.lag(ctx.now))
+        bound = float(driver.deadline_s) + self.slack_s
+        if lag > bound:
+            return [f"failover lag {lag:.3f}s exceeds deadline "
+                    f"{driver.deadline_s:.3f}s + {self.slack_s:.3f}s slack"]
+        return []
+
+
+class OrderedReplay(Invariant):
+    """Parked-frame replay preserves per-session arrival order.
+
+    The :class:`net.failover.ParkingBuffer` stamps every parked frame
+    with a global sequence number and audits replay order itself
+    (``order_violations``); this checker surfaces any *new* breach at
+    the tick it happened."""
+
+    name = "ordered_replay"
+
+    def __init__(self) -> None:
+        self._reported = 0
+
+    def check(self, ctx: DrillContext) -> List[str]:
+        total = int(ctx.cluster.proxy.parking.order_violations)
+        if total > self._reported:
+            fresh = total - self._reported
+            self._reported = total
+            return [f"{fresh} parked frame(s) replayed out of per-session "
+                    "arrival order"]
+        return []
+
+
+class ConsistentCounters(Invariant):
+    """The failover/parking telemetry bank stays conserved:
+
+    - sessions: ``nf_failover_initiated_total == completed +
+      deadline_exceeded + pending`` (every initiated re-home is exactly
+      one of finished, abandoned, or still in flight);
+    - frames: ``parked_total == replayed_total + dropped_total +
+      depth()`` on the parking buffer;
+    - ``nf_failover_busy_total`` (placement rounds) is monotone."""
+
+    name = "consistent_counters"
+
+    def __init__(self) -> None:
+        self._prev_busy = 0.0
+
+    def check(self, ctx: DrillContext) -> List[str]:
+        out: List[str] = []
+        world = ctx.cluster.world
+        driver = getattr(world, "failover", None)
+        if driver is not None:
+            reg = world.telemetry.registry
+            initiated = reg.value("nf_failover_initiated_total")
+            completed = reg.value("nf_failover_completed_total")
+            deadline = reg.value("nf_failover_deadline_exceeded_total")
+            pending = float(driver.pending_count())
+            if initiated != completed + deadline + pending:
+                out.append(
+                    "failover bank not conserved: initiated="
+                    f"{initiated:g} != completed={completed:g} + "
+                    f"deadline={deadline:g} + pending={pending:g}"
+                )
+            busy = reg.value("nf_failover_busy_total")
+            if busy < self._prev_busy:
+                out.append(f"nf_failover_busy_total went backwards: "
+                           f"{self._prev_busy:g} -> {busy:g}")
+            else:
+                self._prev_busy = busy
+        parking = ctx.cluster.proxy.parking
+        still = int(parking.depth())
+        if int(parking.parked_total) != (int(parking.replayed_total)
+                                         + int(parking.dropped_total)
+                                         + still):
+            out.append(
+                "parking bank not conserved: parked="
+                f"{parking.parked_total} != replayed="
+                f"{parking.replayed_total} + dropped="
+                f"{parking.dropped_total} + still_parked={still}"
+            )
+        return out
+
+
+def default_invariants(
+    store_probe: Optional[Callable[[], Dict[str, Tuple[int, int]]]] = None,
+    lag_slack_s: float = 1.0,
+    grace_samples: int = 25,
+) -> List[Invariant]:
+    """The full shipped library, fresh state, ready for one runner."""
+    return [
+        NoSilentDrop(grace_samples=grace_samples),
+        LegalLeaseTransitions(),
+        MonotoneWatermarks(store_probe=store_probe),
+        BoundedFailoverLag(slack_s=lag_slack_s),
+        OrderedReplay(),
+        ConsistentCounters(),
+    ]

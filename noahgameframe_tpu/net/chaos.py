@@ -378,6 +378,14 @@ class ChaosDirector:
         rebuilt pipeline continues the SAME fault schedule (op counts
         and first-N budgets do not reset)."""
         link = str(link)
+        # re-wrap guard: revive_role re-runs the chaos hookup, and a
+        # drill may apply_chaos more than once — if the backend is
+        # already this link's wrapper, wrap its INNER store instead of
+        # nesting (a nested pair would double-advance the shared op
+        # clock per call and replay consumed down-windows against the
+        # second count)
+        while isinstance(backend, FaultyStore) and backend.link == link:
+            backend = backend.inner
         w = FaultyStore(
             backend, link, self.plan,
             counts=self.counts.setdefault(link, {}),
@@ -392,14 +400,20 @@ class ChaosDirector:
     def heal(self, pattern: Optional[str] = None) -> int:
         """Turn faults OFF for every link whose name contains `pattern`
         (all links when None), effective immediately on live wrappers
-        and on any future re-dial.  Returns how many live wrappers were
-        healed.
+        and on any future re-dial/re-wrap.  Returns how many distinct
+        links went from faulted to clean — so the call is idempotent:
+        a second identical heal returns 0 and changes nothing.
 
         This is the failover-drill shape (ISSUE 10): inject faults
         through the kill window, then heal and assert the cluster
         actually converges — a plan that stays hostile forever can mask
-        a recovery path that never finishes.  Counts/logs are kept;
-        only the schedules reset."""
+        a recovery path that never finishes.  Counts/logs/rngs are
+        kept; only the schedules reset.  That makes heal safe
+        mid-campaign (ISSUE 11): a `revive_role` re-wrap that races the
+        heal reads the already-healed plan, and because the op clocks
+        and first-N budgets live in the shared counts, re-arming faults
+        later (:meth:`set_store_faults`) cannot resurrect a consumed
+        fault window."""
         if pattern is None:
             self.plan.links.clear()
             self.plan.default = LinkFaults()
@@ -410,14 +424,49 @@ class ChaosDirector:
                                if p not in pattern and pattern not in p}
             self.plan.stores = {p: f for p, f in self.plan.stores.items()
                                 if p not in pattern and pattern not in p}
-        healed = 0
+        healed = set()
         for link, w in self._live:
             if pattern is not None and pattern not in link:
                 continue
+            if w.faults.any():
+                healed.add(link)
             w.faults = (StoreFaults() if isinstance(w, FaultyStore)
                         else LinkFaults())
-            healed += 1
-        return healed
+        return len(healed)
+
+    # -------------------------------------------------- live re-arming
+    def set_link_faults(self, pattern: str, faults: LinkFaults) -> int:
+        """Arm (or re-arm) transport faults mid-campaign: the plan entry
+        is upserted (future re-dials see it) AND every live transport
+        wrapper whose link contains `pattern` switches to `faults`
+        immediately.  Returns how many live links were re-armed.
+
+        Budgets stay consumed: refuse_first counts etc. live in the
+        shared per-link counts, so re-arming an already-exhausted
+        schedule does not restart it."""
+        pattern = str(pattern)
+        self.plan.links[pattern] = faults
+        touched = set()
+        for link, w in self._live:
+            if isinstance(w, FaultyTransport) and pattern in link:
+                w.faults = faults
+                touched.add(link)
+        return len(touched)
+
+    def set_store_faults(self, pattern: str, faults: StoreFaults) -> int:
+        """Store-side twin of :meth:`set_link_faults` — the campaign
+        primitive behind scheduled store outages.  Op clocks and
+        first-N budgets live in the shared counts, so a re-armed
+        schedule continues from the link's current op count; a consumed
+        ``fail_first`` budget or passed ``down`` window stays consumed."""
+        pattern = str(pattern)
+        self.plan.stores[pattern] = faults
+        touched = set()
+        for link, w in self._live:
+            if isinstance(w, FaultyStore) and pattern in link:
+                w.faults = faults
+                touched.add(link)
+        return len(touched)
 
     def total(self, kind: Optional[str] = None) -> int:
         return sum(
@@ -427,11 +476,62 @@ class ChaosDirector:
             if kind is None or k == kind
         )
 
+    def store_phase(self) -> Dict[str, dict]:
+        """Per-store-link fault *phase* (ISSUE 11 satellite): where each
+        store link's op clock sits relative to its schedule — ops seen,
+        remaining first-N fail budget, the active/upcoming down windows
+        — so drills and operators can assert the current fault phase
+        from master `/json` instead of inferring it from side effects.
+
+        The effective schedule is read from the newest live wrapper
+        (live re-arming via :meth:`set_store_faults` lands there first)
+        and falls back to the plan for links awaiting a re-wrap."""
+        current: Dict[str, StoreFaults] = {}
+        for link, w in self._live:
+            if isinstance(w, FaultyStore):
+                current[link] = w.faults  # latest wrapper wins
+        links = set(current) | {
+            link for link, c in self.counts.items() if "store_op" in c
+        }
+        phases: Dict[str, dict] = {}
+        for link in sorted(links):
+            c = self.counts.get(link, {})
+            op = int(c.get("store_op", 0))
+            f = current.get(link)
+            if f is None:
+                f = self.plan.for_store(link)
+            active = None
+            remaining = 0
+            for a, b in f.down:
+                if a <= op < b:
+                    active = [int(a), int(b)]
+                    remaining = int(b) - op
+                    break
+            phases[link] = {
+                "ops_seen": op,
+                "fails_injected": int(c.get("store_fail", 0)),
+                "downs_hit": int(c.get("store_down", 0)),
+                "latencies_injected": int(c.get("store_latency", 0)),
+                "fail_first_remaining": (
+                    max(0, int(f.fail_first) - int(c.get("store_fail", 0)))
+                    if f.fail_first else 0
+                ),
+                "fail_p": float(f.fail),
+                "latency_p": float(f.latency),
+                "latency_s": float(f.latency_s),
+                "down_active": active,
+                "down_remaining_ops": remaining,
+                "down_upcoming": [[int(a), int(b)] for a, b in f.down
+                                  if op < a],
+            }
+        return phases
+
     def status(self) -> dict:
         """The plan spelled out for operators: seed + per-link fault
-        budgets (the FaultPlan patterns) + live injected counts.  The
-        master mounts this on /json and game roles journal it, so any
-        chaos run can be re-derived exactly for replay."""
+        budgets (the FaultPlan patterns) + live injected counts + the
+        store links' op-clock phase.  The master mounts this on /json
+        and game roles journal it, so any chaos run can be re-derived
+        exactly for replay."""
         return {
             "seed": int(self.plan.seed),
             "links": {
@@ -443,5 +543,7 @@ class ChaosDirector:
                 pattern: dataclasses.asdict(faults)
                 for pattern, faults in self.plan.stores.items()
             },
+            "store_default": dataclasses.asdict(self.plan.store_default),
+            "store_phase": self.store_phase(),
             "counts": {link: dict(c) for link, c in self.counts.items()},
         }

@@ -134,12 +134,19 @@ class ParkingBuffer:
         self.deadline_s = (deadline_s if deadline_s is not None
                            else _env_float("NF_FAILOVER_DEADLINE_S",
                                            DEADLINE_S_DEFAULT))
-        self._q: Dict[object, Deque[Tuple[float, int, bytes]]] = {}
+        self._q: Dict[object, Deque[Tuple[float, int, bytes, int]]] = {}
         self.parked_total = 0
         self.replayed_total = 0
         self.dropped_overflow = 0
         self.dropped_deadline = 0
         self.dropped_disconnect = 0
+        # per-frame arrival stamp + per-key replay audit (ISSUE 11): the
+        # drill's ordered-replay invariant reads `order_violations` every
+        # pump, so an ordering bug is caught the tick it happens instead
+        # of (maybe) surfacing as a scrambled chat log much later
+        self._seq = 0
+        self._last_replayed: Dict[object, int] = {}
+        self.order_violations = 0
         self._c_parked = self._c_replayed = self._c_dropped = None
         if registry is not None:
             self._c_parked = registry.counter(
@@ -180,7 +187,8 @@ class ParkingBuffer:
         """Hold one frame for `key`; returns how many OLDEST frames were
         dropped to stay under ``max_frames``."""
         q = self._q.setdefault(key, collections.deque())
-        q.append((float(now), int(msg_id), bytes(body)))
+        self._seq += 1
+        q.append((float(now), int(msg_id), bytes(body), self._seq))
         self.parked_total += 1
         if self._c_parked is not None:
             self._c_parked.inc()
@@ -215,11 +223,17 @@ class ParkingBuffer:
             return 0, True
         n = 0
         while q:
-            _t, msg_id, body = q[0]
+            _t, msg_id, body, seq = q[0]
             if not send(msg_id, body):
                 break
             q.popleft()
             n += 1
+            # arrival-order audit: every replayed frame must carry a
+            # strictly increasing stamp per session
+            if seq <= self._last_replayed.get(key, -1):
+                self.order_violations += 1
+            else:
+                self._last_replayed[key] = seq
         self.replayed_total += n
         if n and self._c_replayed is not None:
             self._c_replayed.inc(n)
@@ -232,6 +246,7 @@ class ParkingBuffer:
         """The session itself is gone (client disconnected): drop its
         parked frames; returns the count."""
         q = self._q.pop(key, None)
+        self._last_replayed.pop(key, None)
         n = len(q) if q else 0
         self._drop(n, "disconnect")
         return n

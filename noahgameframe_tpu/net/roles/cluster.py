@@ -97,13 +97,21 @@ class LocalCluster:
         self.games: List[GameRole] = []
         for i in range(n_games):
             name = f"Game{i + 1}"
+            kw = self._merged_game_kwargs(name)
+            # per-game worlds: game_kwargs_by_name may carry a "world"
+            # for ANY game (a game-day survivor needs capacity for the
+            # whole surge); the legacy game_world argument still wins
+            # for Game1
+            world = kw.pop("world", None)
+            if i == 0 and game_world is not None:
+                world = game_world
             self.games.append(
                 GameRole(
                     RoleConfig(6 + i * 10, int(ServerType.GAME),
                                name, host, 0, targets=world_t),
                     backend=backend,
-                    world=game_world if i == 0 else None,
-                    **self._merged_game_kwargs(name),
+                    world=world,
+                    **kw,
                 )
             )
         self.game = self.games[0]
@@ -155,6 +163,14 @@ class LocalCluster:
                 return True
             _time.sleep(sleep)
         return False
+
+    def role_by_name(self, name: str):
+        """Live role by config name ("Game1", "Proxy1", …); raises
+        StopIteration-free KeyError semantics via ValueError."""
+        for r in self.roles:
+            if r.config.name == name:
+                return r
+        raise ValueError(f"no live role named {name!r}")
 
     def wired(self) -> bool:
         """True when the full topology is registered: world+login at
@@ -243,6 +259,22 @@ class LocalCluster:
                         for p, f in plan.stores.items()},
             )
 
+    # ----------------------------------------------------------- drills
+    def attach_drill(self, runner) -> None:
+        """Surface a DrillRunner on the master's /json (``drill`` block:
+        campaign clock, fired/remaining steps, invariant breaches) —
+        the drill-side twin of what apply_chaos does for the fault
+        plan.  Called by :class:`drill.runner.DrillRunner` itself."""
+        self.master.drill_status = runner.status
+        # a recording game role journals the campaign identity, so a
+        # drilled run's journal pins the schedule that shaped it
+        for role in self.roles:
+            note = getattr(role, "journal_note", None)
+            if note is not None:
+                note(kind="drill", campaign=runner.campaign.name,
+                     seed=int(runner.campaign.seed),
+                     steps=len(runner.campaign.steps))
+
     # ----------------------------------------------------- kill / revive
     def kill_role(self, role, hard: bool = False) -> RoleConfig:
         """Kill one role: sockets dropped, removed from the pump.
@@ -282,6 +314,11 @@ class LocalCluster:
             )
         kwargs = self._merged_game_kwargs(cfg.name)
         kwargs["resume"] = resume
+        # an explicit world (fresh substrate for the checkpoint load)
+        # wins over a per-game world remembered in the kwargs map
+        kw_world = kwargs.pop("world", None)
+        if world is None:
+            world = kw_world
         role = GameRole(
             RoleConfig(cfg.server_id, cfg.server_type, cfg.name,
                        self._host, 0, targets=self._world_t),

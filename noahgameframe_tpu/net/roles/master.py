@@ -71,6 +71,10 @@ class MasterRole(ServerRole):
         # points this at director.status so /json shows the fault-plan
         # seed + per-link budgets (replay can re-derive the chaos run)
         self.chaos_status = None  # Optional[Callable[[], dict]]
+        # drill visibility (ISSUE 11): when a DrillRunner is attached the
+        # harness points this at runner.status so /json shows the live
+        # campaign clock, fired/remaining steps, and invariant breaches
+        self.drill_status = None  # Optional[Callable[[], dict]]
         self.lease_suspect_seconds = lease_suspect_seconds
         self.lease_down_seconds = lease_down_seconds
         # per-role monotonic clock offsets estimated from the mono_ns
@@ -275,6 +279,11 @@ class MasterRole(ServerRole):
                 status["chaos"] = self.chaos_status()
             except Exception:  # noqa: BLE001 — a dead probe must not kill /json
                 status["chaos"] = {"error": "chaos status unavailable"}
+        if self.drill_status is not None:
+            try:
+                status["drill"] = self.drill_status()
+            except Exception:  # noqa: BLE001 — a dead probe must not kill /json
+                status["drill"] = {"error": "drill status unavailable"}
         # session-failover health (ISSUE 10): each world's heartbeat ext
         # carries pending re-homes + oldest-pending age; aggregate them
         # so operators see a stuck failover without scraping every world

@@ -96,6 +96,17 @@ class ProxyRole(ServerRole):
         # the world's driver re-homes the session and the target's
         # re-point lands (_on_switch_route)
         self.parking = ParkingBuffer(registry=self.telemetry.registry)
+        # switch-notice accounting (ISSUE 11): the drill's no-silent-drop
+        # invariant needs to prove every unbound session *heard* about it
+        # — aggregate per code, and per client conn (cleared with the
+        # conn) so a specific orphan can be checked, not just totals
+        self.notice_counts: Dict[int, int] = {}
+        self.conn_notices: Dict[int, Dict[int, int]] = {}
+        self._c_notices = self.telemetry.registry.counter(
+            "nf_switch_notices_total",
+            "ACK_SWITCH_NOTICE control frames pushed to clients",
+            ("code",),
+        )
 
     def _install(self) -> None:
         s = self.server
@@ -172,6 +183,15 @@ class ProxyRole(ServerRole):
         self.server.send_raw(
             conn_id, int(MsgID.ACK_SWITCH_NOTICE), wrap(notice)
         )
+        self.notice_counts[int(code)] = (
+            self.notice_counts.get(int(code), 0) + 1)
+        per = self.conn_notices.setdefault(conn_id, {})
+        per[int(code)] = per.get(int(code), 0) + 1
+        try:
+            label = SwitchNoticeCode(int(code)).name
+        except ValueError:
+            label = str(int(code))
+        self._c_notices.inc(code=label)
 
     # ------------------------------------------------------ client side
     def _on_connect_key(self, conn_id: int, _msg_id: int, body: bytes) -> None:
@@ -275,6 +295,7 @@ class ProxyRole(ServerRole):
         # anything still parked for a dead client socket has no receiver
         # for its replies either — drop it (counted reason="disconnect")
         self.parking.discard(conn_id)
+        self.conn_notices.pop(conn_id, None)
         # tell the game its player is gone (the reference proxy fires
         # REQ_LEAVE_GAME upstream when a client socket dies)
         info = self._conn_info.pop(conn_id, None)

@@ -28,7 +28,10 @@ PKG = Path(__file__).resolve().parent.parent / "noahgameframe_tpu"
 # persist/ rides along (ISSUE 6): write-behind batch identity (seq, tick)
 # must never include a wall clock — recovery flushes have to be
 # byte-identical to the flushes a crash interrupted
-SCANNED_DIRS = ("kernel", "ops", "game", "persist")
+# drill/ rides along (ISSUE 11): campaign scheduling is tick-indexed by
+# contract — a wall clock in a schedule or invariant would turn a
+# repeatable game-day drill back into an anecdote
+SCANNED_DIRS = ("kernel", "ops", "game", "persist", "drill")
 # frame observatory (ISSUE 7): the stage clock and the trace wire path
 # (game emit/ack, proxy stamp, client echo) stamp with perf_counter_ns —
 # fine — but a time.time() anywhere on these paths could leak wall clock
@@ -319,6 +322,64 @@ def test_proxy_parking_pump_never_blocks(method):
     offenses = list(_blocking_calls(methods[method]))
     assert not offenses, (
         "blocking call on the proxy parking path:\n" + "\n".join(offenses)
+    )
+
+
+# --- drill clock contract (ISSUE 11): campaigns and invariants are
+# tick-indexed, never wall-timed.  Stronger than the RNG/wall-clock lint
+# above: schedule.py and invariants.py must not reference the `time`
+# module AT ALL (even monotonic would smuggle a runtime clock into what
+# is declaratively a tick schedule); runner.py is the single component
+# allowed to touch the clock, and only as pump pacing — monotonic()
+# and sleep(), nothing else.
+DRILL = PKG / "drill"
+DRILL_CLOCKLESS = ("schedule.py", "invariants.py")
+RUNNER_CLOCK_ALLOWED = {"monotonic", "sleep"}
+
+
+def _time_refs(path: Path):
+    """Every dotted use rooted in a `time` import, plus the imports
+    themselves (`import time [as x]` / `from time import ...`)."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    aliases = set()
+    refs = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    aliases.add(a.asname or a.name)
+                    refs.append((node.lineno, "import time"))
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                refs.append((node.lineno, f"from time import {a.name}"))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            dotted = _dotted(node)
+            if dotted is not None and dotted.split(".")[0] in aliases:
+                refs.append((node.lineno, dotted))
+    return refs
+
+
+@pytest.mark.parametrize("fname", DRILL_CLOCKLESS)
+def test_drill_schedule_and_invariants_are_clockless(fname):
+    refs = _time_refs(DRILL / fname)
+    assert not refs, (
+        f"drill/{fname} references the time module — campaign "
+        "schedules/invariants are tick-indexed by contract:\n"
+        + "\n".join(f"  line {ln}: {what}" for ln, what in refs)
+    )
+
+
+def test_drill_runner_clock_is_pacing_only():
+    offenses = [
+        (ln, what) for ln, what in _time_refs(DRILL / "runner.py")
+        if "." in what  # attribute uses; the import line itself is fine
+        and what.split(".")[-1] not in RUNNER_CLOCK_ALLOWED
+    ]
+    assert not offenses, (
+        "drill/runner.py touches the clock beyond monotonic/sleep "
+        "pacing:\n"
+        + "\n".join(f"  line {ln}: {what}" for ln, what in offenses)
     )
 
 

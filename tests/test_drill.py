@@ -1,0 +1,662 @@
+"""Game-day drill engine (ISSUE 11): campaigns, runner, invariants.
+
+Three layers, cheapest first:
+
+- Campaign/Step units: build-time validation, stable ordering, JSON-safe
+  description of live kwargs (callables, fault dataclasses).
+- DrillRunner over a *forged* cluster (SimpleNamespace stand-ins + its
+  own MetricsRegistry): step firing, action dispatch, telemetry, the
+  violation cap.  Invariants read cluster state defensively by design,
+  so each one also gets a seeded *violation* test — a forged cluster in
+  a state the invariant must reject.  These tests fail if the invariant
+  is disabled (returns []), which is exactly the regression they guard.
+- ChaosDirector campaign primitives (ISSUE 11 satellites): store-phase
+  exposure, live re-arming with consumed budgets, idempotent heal, and
+  the re-wrap guard.
+- The flagship game-day itself: short mode in tier-1, the full
+  40-session campaign marked ``slow``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from noahgameframe_tpu.drill import (
+    BoundedFailoverLag,
+    Campaign,
+    ConsistentCounters,
+    DrillContext,
+    DrillRunner,
+    LegalLeaseTransitions,
+    MonotoneWatermarks,
+    NoSilentDrop,
+    OrderedReplay,
+    Step,
+    default_invariants,
+    merged,
+)
+from noahgameframe_tpu.net.chaos import (
+    ChaosDirector,
+    FaultPlan,
+    LinkFaults,
+    StoreFaultError,
+    StoreFaults,
+)
+from noahgameframe_tpu.net.defines import SwitchNoticeCode
+from noahgameframe_tpu.net.failover import ParkingBuffer
+from noahgameframe_tpu.telemetry.registry import MetricsRegistry
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "scripts" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------- Campaign
+class TestCampaign:
+    def test_builder_sorts_by_tick_stable_within_tick(self):
+        c = (Campaign("t", seed=3)
+             .add(5, "note", label="second-at-5")
+             .add(1, "note", label="early")
+             .add(5, "note", label="third-at-5"))
+        assert [s.label for s in c.steps] == [
+            "early", "second-at-5", "third-at-5"]
+        assert c.horizon == 5
+        assert len(c) == 3
+        assert c.seed == 3
+
+    def test_negative_tick_rejected(self):
+        with pytest.raises(ValueError, match="at_tick"):
+            Campaign("t").add(-1, "note")
+        with pytest.raises(ValueError, match="at_tick"):
+            Campaign("t", steps=[Step(-2, "note")])
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError, match="unknown action"):
+            Campaign("t").add(0, "reboot_datacenter")
+        with pytest.raises(ValueError, match="'call'"):
+            Campaign("t", steps=[Step(0, "frobnicate")])
+
+    def test_describe_is_json_safe_with_live_kwargs(self):
+        # kwargs hold exactly what real campaigns carry: a fault
+        # dataclass, a world factory, a plain scalar
+        c = (Campaign("t", seed=7)
+             .add(5, "store_faults", pattern="game6.store",
+                  faults=StoreFaults(fail_first=3))
+             .add(9, "call", fn=lambda r: None)
+             .add(2, "kill_role", role="Game1", hard=True))
+        desc = c.describe()
+        json.dumps(desc)  # must not raise
+        assert desc["name"] == "t" and desc["seed"] == 7
+        assert desc["horizon"] == 9
+        by_action = {s["action"]: s for s in desc["steps"]}
+        assert by_action["store_faults"]["kwargs"]["faults"][
+            "fail_first"] == 3
+        assert by_action["call"]["kwargs"]["fn"].startswith("<callable")
+        assert by_action["kill_role"]["kwargs"] == {
+            "role": "Game1", "hard": True}
+
+    def test_merged_shifts_offsets_and_defaults_labels(self):
+        outage = Campaign("outage").add(0, "note").add(4, "heal")
+        kill = Campaign("kill").add(0, "kill_role", role="Game1",
+                                    label="boom")
+        c = merged("gameday", 7, (10, outage), (12, kill))
+        assert [(s.at_tick, s.action, s.label) for s in c.steps] == [
+            (10, "note", "outage:note"),
+            (12, "kill_role", "boom"),
+            (14, "heal", "outage:heal"),
+        ]
+        assert c.seed == 7 and c.horizon == 14
+
+
+# ------------------------------------------------------------ DrillRunner
+class _AlwaysViolates:
+    name = "always"
+
+    def check(self, ctx):
+        return ["forged breach"]
+
+
+class _NeverViolates:
+    name = "never"
+
+    def check(self, ctx):
+        return []
+
+
+def _fake_cluster(log):
+    """Minimal dispatch target: records every call the runner makes."""
+    role = SimpleNamespace(
+        config=SimpleNamespace(name="Game1"),
+        checkpoint_now=lambda: log.append(("checkpoint", "Game1")),
+    )
+    chaos = SimpleNamespace(
+        heal=lambda pattern: log.append(("heal", pattern)),
+        set_store_faults=lambda p, f: log.append(("store_faults", p, f)),
+        set_link_faults=lambda p, f: log.append(("link_faults", p, f)),
+    )
+    return SimpleNamespace(
+        execute=lambda: log.append(("pump",)),
+        kill_role=lambda role, hard: log.append(("kill", role, hard)),
+        revive_role=lambda name, world, resume: log.append(
+            ("revive", name, world, resume)),
+        chaos=chaos,
+        roles=[role],
+    )
+
+
+class TestRunnerActions:
+    def test_steps_fire_at_their_tick_before_the_pump(self):
+        log = []
+        c = (Campaign("t")
+             .add(0, "note", label="start")
+             .add(2, "kill_role", role="Game1", hard=True)
+             .add(2, "heal", pattern="game6")
+             .add(4, "call", fn=lambda r: log.append(("call", r.tick))))
+        r = DrillRunner(_fake_cluster(log), c, invariants=[],
+                        registry=MetricsRegistry())
+        for _ in range(5):
+            r.step_once()
+        assert log == [
+            ("pump",),                       # tick 0: note is a no-op
+            ("pump",),                       # tick 1
+            ("kill", "Game1", True),         # tick 2: both due steps...
+            ("heal", "game6"),               # ...fire before the pump
+            ("pump",),
+            ("pump",),                       # tick 3
+            ("call", 4),                     # tick 4
+            ("pump",),
+        ]
+        assert r.steps_remaining == 0
+        assert [a["label"] or a["action"] for a in r.actions_fired] == [
+            "start", "kill_role", "heal", "call"]
+        assert [a["tick"] for a in r.actions_fired] == [0, 2, 2, 4]
+
+    def test_all_dispatch_arms_and_telemetry(self):
+        log = []
+        reg = MetricsRegistry()
+        factory_built = []
+
+        def factory():
+            factory_built.append(1)
+            return "fresh-world"
+
+        c = (Campaign("t")
+             .add(0, "store_faults", pattern="game6.store",
+                  faults=StoreFaults(fail_first=1))
+             .add(0, "link_faults", pattern="proxy5",
+                  faults=LinkFaults(dup=0.5))
+             .add(1, "checkpoint", role="Game1")
+             .add(2, "revive_role", name="Game1",
+                  world_factory=factory, resume=True))
+        r = DrillRunner(_fake_cluster(log), c, invariants=[], registry=reg)
+        for _ in range(3):
+            r.step_once()
+        kinds = [e[0] for e in log]
+        assert kinds == ["store_faults", "link_faults", "pump",
+                         "checkpoint", "pump", "revive", "pump"]
+        # the factory is only called when the step fires, and its world
+        # is what reaches revive_role
+        assert factory_built == [1]
+        assert log[5] == ("revive", "Game1", "fresh-world", True)
+        assert reg.value("nf_drill_ticks_total") == 3.0
+        assert reg.value("nf_drill_actions_total",
+                         action="store_faults") == 1.0
+        assert reg.value("nf_drill_actions_total",
+                         action="revive_role") == 1.0
+
+    def test_violation_cap_keeps_counting_past_the_cap(self):
+        reg = MetricsRegistry()
+        r = DrillRunner(_fake_cluster([]), Campaign("t"),
+                        invariants=[_AlwaysViolates(), _NeverViolates()],
+                        registry=reg, max_violations=5)
+        for _ in range(9):
+            r.step_once()
+        assert len(r.violations) == 5          # stored verbatim: capped
+        rep = r.report()
+        assert not rep.clean
+        assert rep.checks == {"always": 9, "never": 9}
+        # ...but the tally and the counter never stop
+        assert reg.value("nf_drill_invariant_violations_total",
+                         invariant="always") == 9.0
+        assert reg.value("nf_drill_invariant_checks_total",
+                         invariant="never") == 9.0
+        assert r.status()["invariant_violations"] == {"always": 9}
+
+    def test_status_block_is_json_safe(self):
+        c = (Campaign("gameday", seed=7)
+             .add(3, "kill_role", role="Game1", hard=True)
+             .add(8, "call", fn=lambda r: None))
+        r = DrillRunner(_fake_cluster([]), c, invariants=[],
+                        registry=MetricsRegistry())
+        r.step_once()
+        st = r.status()
+        json.dumps(st)  # /json mounts this verbatim
+        assert st["campaign"] == "gameday" and st["seed"] == 7
+        assert st["tick"] == 1 and st["horizon"] == 8
+        assert st["actions_fired"] == 0 and st["steps_remaining"] == 2
+        assert st["next_step"]["at_tick"] == 3
+
+    def test_report_round_trips_through_json(self):
+        c = Campaign("t").add(0, "call", fn=lambda r: None)
+        r = DrillRunner(_fake_cluster([]), c,
+                        invariants=[_AlwaysViolates()],
+                        registry=MetricsRegistry())
+        r.step_once()
+        rep = r.report()
+        blob = json.dumps(rep.to_dict())
+        back = json.loads(blob)
+        assert back["clean"] is False
+        assert back["invariant_violations"] == {"always": 1}
+        assert back["violations"][0] == {
+            "invariant": "always", "tick": 0, "detail": "forged breach"}
+
+    def test_default_invariants_is_the_full_library(self):
+        names = {i.name for i in default_invariants()}
+        assert names == {
+            "no_silent_drop", "legal_lease_transitions",
+            "monotone_watermarks", "bounded_failover_lag",
+            "ordered_replay", "consistent_counters",
+        }
+
+
+# ------------------------------------- seeded violations, one per checker
+def _ctx(cluster, tick=0, now=0.0):
+    return DrillContext(cluster=cluster, tick=tick, now=now)
+
+
+def _proxy(parking=None, live=(6,), conn_info=None, notices=None,
+           conn_notices=None):
+    return SimpleNamespace(
+        parking=parking if parking is not None else ParkingBuffer(),
+        games=SimpleNamespace(servers={int(g): object() for g in live}),
+        _conn_info=dict(conn_info or {}),
+        notice_counts=dict(notices or {}),
+        conn_notices=dict(conn_notices or {}),
+    )
+
+
+class TestNoSilentDrop:
+    def test_dropped_frames_without_notice_violate(self):
+        pb = ParkingBuffer(max_frames=1, deadline_s=60.0)
+        pb.park("c1", 3, b"a", now=0.0)
+        pb.park("c1", 3, b"b", now=0.0)  # overflow: oldest dropped
+        assert pb.dropped_overflow == 1
+        inv = NoSilentDrop()
+        cluster = SimpleNamespace(proxy=_proxy(parking=pb))
+        out = inv.check(_ctx(cluster))
+        assert out and "zero DROPPED notices" in out[0]
+        # same drop WITH a notice pushed: clean
+        inv2 = NoSilentDrop()
+        cluster.proxy.notice_counts = {int(SwitchNoticeCode.DROPPED): 1}
+        assert inv2.check(_ctx(cluster)) == []
+
+    def test_unbound_session_past_grace_violates(self):
+        inv = NoSilentDrop(grace_samples=3)
+        cluster = SimpleNamespace(proxy=_proxy(
+            live=(16,), conn_info={"c9": {"game_id": 6}}))
+        assert inv.check(_ctx(cluster)) == []      # streak 1
+        assert inv.check(_ctx(cluster)) == []      # streak 2
+        out = inv.check(_ctx(cluster))             # streak 3 = grace
+        assert out and "no switch notice" in out[0]
+        # a notice (any code) on that conn silences the clause
+        cluster.proxy.conn_notices = {"c9": [int(SwitchNoticeCode.REHOMING)]}
+        assert NoSilentDrop(grace_samples=1).check(_ctx(cluster)) == []
+
+
+class TestLegalLeaseTransitions:
+    def _master(self, lease):
+        return SimpleNamespace(
+            lease_suspect_seconds=1.0, lease_down_seconds=2.0,
+            registry={6: {6: SimpleNamespace(lease=lease)}})
+
+    def test_up_to_down_with_tight_sampling_violates(self):
+        inv = LegalLeaseTransitions()
+        m = self._master("UP")
+        cluster = SimpleNamespace(master=m)
+        assert inv.check(_ctx(cluster, now=0.0)) == []   # baseline
+        m.registry[6][6].lease = "DOWN"
+        out = inv.check(_ctx(cluster, now=0.01))         # gap << window
+        assert out and "UP->DOWN" in out[0]
+
+    def test_legal_path_is_clean(self):
+        inv = LegalLeaseTransitions()
+        m = self._master("UP")
+        cluster = SimpleNamespace(master=m)
+        for i, lease in enumerate(
+                ["UP", "SUSPECT", "DOWN", "UP", "SUSPECT", "UP"]):
+            m.registry[6][6].lease = lease
+            assert inv.check(_ctx(cluster, now=0.01 * i)) == [], lease
+
+    def test_coarse_gap_tolerates_skipped_suspect(self):
+        inv = LegalLeaseTransitions()
+        m = self._master("UP")
+        cluster = SimpleNamespace(master=m)
+        inv.check(_ctx(cluster, now=0.0))
+        m.registry[6][6].lease = "DOWN"
+        # gap 5 s > the 1 s SUSPECT window: the sampler stalled through
+        # the intermediate state, the machine did not
+        assert inv.check(_ctx(cluster, now=5.0)) == []
+
+    def test_previous_gap_also_excuses_the_jump(self):
+        # regression for the pass-structure timing: the master sweeps at
+        # the TOP of a pump pass, the drill samples at the BOTTOM — a
+        # stall late in pass N lands in the N-1→N sample gap while the
+        # lease jump only shows at sweep N+1, one sample later
+        inv = LegalLeaseTransitions()
+        m = self._master("UP")
+        cluster = SimpleNamespace(master=m)
+        inv.check(_ctx(cluster, now=0.0))
+        inv.check(_ctx(cluster, now=5.0))    # the big gap, lease still UP
+        m.registry[6][6].lease = "DOWN"
+        assert inv.check(_ctx(cluster, now=5.01)) == []
+        # but TWO samples later the excuse has expired
+        m.registry[6][6].lease = "UP"
+        inv.check(_ctx(cluster, now=5.02))
+        m.registry[6][6].lease = "DOWN"
+        out = inv.check(_ctx(cluster, now=5.03))
+        assert out and "UP->DOWN" in out[0]
+
+
+class TestMonotoneWatermarks:
+    def _cluster(self, seq, tick):
+        wal = SimpleNamespace(flushed_seq=seq, flushed_tick=tick)
+        game = SimpleNamespace(persist=SimpleNamespace(name="Game1",
+                                                       wal=wal))
+        return SimpleNamespace(games=[game]), wal
+
+    def test_seq_regression_violates(self):
+        inv = MonotoneWatermarks()
+        cluster, wal = self._cluster(5, 10)
+        assert inv.check(_ctx(cluster)) == []
+        wal.flushed_seq = 3
+        out = inv.check(_ctx(cluster))
+        assert out and "moved backwards" in out[0]
+
+    def test_tick_regression_at_equal_seq_violates(self):
+        inv = MonotoneWatermarks()
+        cluster, wal = self._cluster(5, 10)
+        inv.check(_ctx(cluster))
+        wal.flushed_tick = 9
+        assert inv.check(_ctx(cluster))
+
+    def test_disappear_then_restart_below_watermark_is_caught(self):
+        # a killed role's key vanishes; the baseline must survive so a
+        # revived pipeline restarting low is caught on first report
+        probe = {"store:g1": (7, 40)}
+        inv = MonotoneWatermarks(store_probe=lambda: dict(probe))
+        cluster = SimpleNamespace(games=[])
+        assert inv.check(_ctx(cluster)) == []
+        probe.clear()                                   # role killed
+        assert inv.check(_ctx(cluster)) == []
+        probe["store:g1"] = (2, 5)                      # revived too low
+        out = inv.check(_ctx(cluster))
+        assert out and "7:40 -> 2:5" in out[0]
+
+    def test_advancing_marks_are_clean(self):
+        inv = MonotoneWatermarks()
+        cluster, wal = self._cluster(1, 1)
+        for seq in range(1, 5):
+            wal.flushed_seq, wal.flushed_tick = seq, seq * 3
+            assert inv.check(_ctx(cluster)) == []
+
+
+class TestBoundedFailoverLag:
+    def _cluster(self, lag):
+        driver = SimpleNamespace(deadline_s=2.0, lag=lambda now: lag)
+        return SimpleNamespace(world=SimpleNamespace(failover=driver))
+
+    def test_lag_past_deadline_plus_slack_violates(self):
+        inv = BoundedFailoverLag(slack_s=0.5)
+        out = inv.check(_ctx(self._cluster(lag=2.6)))
+        assert out and "exceeds deadline" in out[0]
+
+    def test_lag_within_bound_is_clean(self):
+        inv = BoundedFailoverLag(slack_s=0.5)
+        assert inv.check(_ctx(self._cluster(lag=2.4))) == []
+
+
+class TestOrderedReplay:
+    def test_scrambled_replay_violates_once(self):
+        # drive the REAL ParkingBuffer's seq audit: park in order,
+        # scramble the queue behind its back, replay
+        pb = ParkingBuffer(max_frames=16, deadline_s=60.0)
+        for i in range(3):
+            pb.park("c1", 3, b"m%d" % i, now=0.0)
+        pb._q["c1"].rotate(1)  # last frame now replays first
+        sent = []
+        pb.replay("c1", lambda mid, body: sent.append(body) or True)
+        assert pb.order_violations > 0
+        inv = OrderedReplay()
+        cluster = SimpleNamespace(proxy=SimpleNamespace(parking=pb))
+        out = inv.check(_ctx(cluster))
+        assert out and "out of per-session" in out[0]
+        # watermark: the same breach is not re-reported next sample
+        assert inv.check(_ctx(cluster)) == []
+
+    def test_in_order_replay_is_clean(self):
+        pb = ParkingBuffer(max_frames=16, deadline_s=60.0)
+        for i in range(3):
+            pb.park("c1", 3, b"m%d" % i, now=0.0)
+        pb.replay("c1", lambda mid, body: True)
+        assert pb.order_violations == 0
+        inv = OrderedReplay()
+        cluster = SimpleNamespace(proxy=SimpleNamespace(parking=pb))
+        assert inv.check(_ctx(cluster)) == []
+
+
+class _FakeReg:
+    """value()-only registry stand-in so counters can be *forged*
+    (a real Counter cannot go backwards, which is the point of the
+    busy-monotone clause)."""
+
+    def __init__(self, **vals):
+        self.vals = dict(vals)
+
+    def value(self, name, **labels):
+        return float(self.vals.get(name, 0.0))
+
+
+def _counters_cluster(reg, pending=0, parking=None):
+    driver = SimpleNamespace(pending_count=lambda: pending)
+    world = SimpleNamespace(failover=driver,
+                            telemetry=SimpleNamespace(registry=reg))
+    proxy = SimpleNamespace(
+        parking=parking if parking is not None else ParkingBuffer())
+    return SimpleNamespace(world=world, proxy=proxy)
+
+
+class TestConsistentCounters:
+    def test_unbalanced_failover_bank_violates(self):
+        reg = _FakeReg(nf_failover_initiated_total=3.0,
+                       nf_failover_completed_total=1.0,
+                       nf_failover_deadline_exceeded_total=0.0)
+        out = ConsistentCounters().check(
+            _ctx(_counters_cluster(reg, pending=1)))
+        assert out and "failover bank not conserved" in out[0]
+
+    def test_balanced_bank_is_clean(self):
+        reg = _FakeReg(nf_failover_initiated_total=3.0,
+                       nf_failover_completed_total=2.0,
+                       nf_failover_deadline_exceeded_total=0.0)
+        assert ConsistentCounters().check(
+            _ctx(_counters_cluster(reg, pending=1))) == []
+
+    def test_parking_bank_not_conserved_violates(self):
+        pb = ParkingBuffer(max_frames=16, deadline_s=60.0)
+        pb.park("c1", 3, b"x", now=0.0)
+        pb.parked_total += 1  # forge a leak: one frame unaccounted for
+        out = ConsistentCounters().check(
+            _ctx(_counters_cluster(_FakeReg(), parking=pb)))
+        assert out and "parking bank not conserved" in out[0]
+
+    def test_busy_counter_going_backwards_violates(self):
+        reg = _FakeReg(nf_failover_busy_total=5.0)
+        inv = ConsistentCounters()
+        cluster = _counters_cluster(reg)
+        assert inv.check(_ctx(cluster)) == []
+        reg.vals["nf_failover_busy_total"] = 3.0
+        out = inv.check(_ctx(cluster))
+        assert out and "busy_total went backwards" in out[0]
+
+
+# ------------------------------------ chaos campaign primitives (sat 2+3)
+class _Backend:
+    """Write-behind StoreBackend seam stand-in."""
+
+    def __init__(self):
+        self.data = {}
+
+    def write(self, key, blob):
+        self.data[key] = blob
+
+    def delete(self, key):
+        self.data.pop(key, None)
+
+    def ping(self):
+        return True
+
+
+class TestChaosCampaignPrimitives:
+    def test_store_phase_exposes_op_clock_and_budgets(self):
+        d = ChaosDirector(FaultPlan(
+            seed=7, stores={"game6.store": StoreFaults(fail_first=2)}))
+        store = d.wrap_store(_Backend(), "game6.store")
+        for _ in range(2):
+            with pytest.raises(StoreFaultError):
+                store.write("k", b"v")
+        store.write("k", b"v")  # budget consumed: third call lands
+        ph = d.store_phase()["game6.store"]
+        assert ph["ops_seen"] == 3
+        assert ph["fails_injected"] == 2
+        assert ph["fail_first_remaining"] == 0
+        assert ph["down_active"] is None and ph["down_upcoming"] == []
+        # status() carries the phase block (this is what /json mounts)
+        assert d.status()["store_phase"]["game6.store"][
+            "ops_seen"] == 3
+
+    def test_store_phase_tracks_down_windows(self):
+        d = ChaosDirector(FaultPlan(
+            seed=7, stores={"game6.store": StoreFaults(down=((2, 4),))}))
+        store = d.wrap_store(_Backend(), "game6.store")
+        store.write("a", b"1")
+        store.write("b", b"2")
+        ph = d.store_phase()["game6.store"]
+        assert ph["down_active"] == [2, 4]      # op clock sits at 2
+        assert ph["down_remaining_ops"] == 2
+        for _ in range(2):
+            with pytest.raises(StoreFaultError):
+                store.write("c", b"3")
+        store.write("c", b"3")                   # window passed
+        ph = d.store_phase()["game6.store"]
+        assert ph["downs_hit"] == 2
+        assert ph["down_active"] is None and ph["down_upcoming"] == []
+
+    def test_set_store_faults_rearms_live_wrappers(self):
+        d = ChaosDirector(FaultPlan(seed=7))
+        store = d.wrap_store(_Backend(), "game6.store")
+        store.write("k", b"v")  # no faults armed yet
+        assert d.set_store_faults("game6.store",
+                                  StoreFaults(fail_first=1)) == 1
+        with pytest.raises(StoreFaultError):
+            store.write("k", b"v")  # live wrapper re-armed immediately
+        # the plan was upserted too: a future re-wrap sees the faults
+        assert d.plan.stores["game6.store"].fail_first == 1
+
+    def test_heal_is_idempotent(self):
+        d = ChaosDirector(FaultPlan(
+            seed=7,
+            links={"proxy5.games": LinkFaults(dup=0.5)},
+            stores={"game6.store": StoreFaults(fail_first=5)}))
+        t = d.wrap(SimpleNamespace(), "proxy5.games->6")
+        s = d.wrap_store(_Backend(), "game6.store")
+        assert t.faults.any() and s.faults.any()
+        assert d.heal("game6.store") == 1   # the store link went clean
+        assert not s.faults.any()
+        assert t.faults.any()               # pattern-scoped: link kept
+        assert d.heal("game6.store") == 0   # idempotent: nothing left
+        assert d.heal() == 1                # the transport link
+        assert not t.faults.any()
+        assert d.heal() == 0
+        assert not d.plan.links and not d.plan.stores
+
+    def test_rewrap_does_not_nest_or_reset(self):
+        d = ChaosDirector(FaultPlan(
+            seed=7, stores={"game6.store": StoreFaults(fail_first=1)}))
+        backend = _Backend()
+        s1 = d.wrap_store(backend, "game6.store")
+        with pytest.raises(StoreFaultError):
+            s1.write("k", b"v")
+        # revive_role re-runs the chaos hookup on the same pipeline: the
+        # guard unwraps instead of nesting, so the shared op clock is
+        # not double-advanced
+        s2 = d.wrap_store(s1, "game6.store")
+        assert s2.inner is backend
+        s2.write("k", b"v")  # budget already consumed on the shared counts
+        assert d.store_phase()["game6.store"]["ops_seen"] == 2
+
+    def test_consumed_budget_survives_heal_and_rearm(self):
+        # the ISSUE 11 satellite: heal() + later re-arm must NOT
+        # resurrect a consumed first-N window
+        d = ChaosDirector(FaultPlan(
+            seed=7, stores={"game6.store": StoreFaults(fail_first=1)}))
+        store = d.wrap_store(_Backend(), "game6.store")
+        with pytest.raises(StoreFaultError):
+            store.write("k", b"v")
+        d.heal("game6.store")
+        store.write("k", b"v")
+        # re-arm the SAME schedule; the fail budget lives in the shared
+        # counts, so nothing fires again
+        d.set_store_faults("game6.store", StoreFaults(fail_first=1))
+        store.write("k", b"v")
+        # and a fresh re-wrap (revive path) continues, not restarts
+        store2 = d.wrap_store(_Backend(), "game6.store")
+        store2.write("k", b"v")
+        assert d.store_phase()["game6.store"]["fails_injected"] == 1
+
+    def test_set_link_faults_rearms_live_transports(self):
+        d = ChaosDirector(FaultPlan(seed=7))
+        t = d.wrap(SimpleNamespace(), "proxy5.games->6")
+        assert not t.faults.any()
+        assert d.set_link_faults("proxy5.games", LinkFaults(dup=0.9)) == 1
+        assert t.faults.dup == 0.9
+        assert d.plan.links["proxy5.games"].dup == 0.9
+
+
+# ----------------------------------------------------- the flagship drill
+@pytest.fixture(scope="module")
+def gameday():
+    return _load_script("gameday_smoke")
+
+
+class TestGamedayE2E:
+    def test_gameday_short_campaign(self, gameday, tmp_path):
+        # tier-1 sized: 6 sessions, 3 chats — same campaign shape
+        # (store outage ⊃ kill ⊃ surge, heal, revive), ~20 s
+        checks = gameday.run(tmp_path, seed=7, sessions=6, chats=3)
+        failed = [name for name, ok in checks.items() if not ok]
+        assert not failed
+
+    @pytest.mark.slow
+    def test_gameday_full_campaign(self, gameday, tmp_path):
+        checks = gameday.run(tmp_path, seed=7, sessions=40, chats=5,
+                             out_path=tmp_path / "r07_gameday.json")
+        failed = [name for name, ok in checks.items() if not ok]
+        assert not failed
+        blob = json.loads((tmp_path / "r07_gameday.json").read_text())
+        assert blob["metric"] == "gameday_sessions_rehomed_per_sec"
+        assert blob["detail"]["replay_ok"] is True
+        assert blob["detail"]["drill_clean"] is True
