@@ -137,6 +137,9 @@ class GuidAllocator:
             if last is not None:
                 self._last = int(last)
             elif self._last == 0:
+                # nf-lint: disable=wall-clock -- one-shot seed so pinned
+                # and unpinned allocators land in disjoint guid ranges;
+                # replay determinism comes from pin(last=...) instead
                 self._last = int(_time.time() * 1_000_000)
             self.pinned = True
             return self._last
@@ -146,6 +149,9 @@ class GuidAllocator:
             if self.pinned:
                 self._last += 1
                 return Guid(self._app_id, self._last)
+            # nf-lint: disable=wall-clock -- unpinned live mode is
+            # wall-clock BY DESIGN (guids order across restarts);
+            # deterministic runs pin() before allocating
             now = int(_time.time() * 1_000_000)
             if now <= self._last:
                 now = self._last + 1
@@ -159,6 +165,8 @@ class GuidAllocator:
             if self.pinned:
                 now = self._last + 1
             else:
+                # nf-lint: disable=wall-clock -- same live-mode contract
+                # as next(): deterministic runs pin() first
                 now = int(_time.time() * 1_000_000)
                 if now <= self._last:
                     now = self._last + 1

@@ -33,7 +33,7 @@ Checkers read cluster state defensively (``getattr`` with fallbacks) so
 violation tests can feed them minimal forged stand-ins.
 
 This module is tick-indexed like the schedules: it must not reference
-the ``time`` module (structural lint in tests/test_determinism_lint.py).
+the ``time`` module (nf-lint ``drill-clockless`` rule, docs/LINT.md).
 """
 
 from __future__ import annotations

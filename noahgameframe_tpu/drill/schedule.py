@@ -5,8 +5,8 @@ clock is the **drill tick** — one tick per :meth:`DrillRunner.step_once`
 pump pass — never wall time: the same campaign over the same cluster
 fires the same actions at the same points in the event stream, which is
 what makes a game-day drill a regression test instead of an anecdote.
-(The determinism lint enforces this structurally: this module must not
-reference the ``time`` module at all.)
+(The nf-lint ``drill-clockless`` rule enforces this structurally: this
+module must not reference the ``time`` module at all.)
 
 Built-in actions (resolved by the runner against its cluster):
 

@@ -46,7 +46,7 @@ ATTACK_TIMER = "Attack"
 NO_ROW = 16777216.0
 
 
-def combat_fold_closure(v, radius):
+def combat_fold_closure(v, radius: float):
     """(fold, init) over a victim grid view v [H, W, Kv, F+1] — the
     fold body shared by combat_fold_xla (square grids) and the spatial
     slab shards (rectangular grids with real halo rows,
@@ -424,6 +424,9 @@ class CombatModule(Module):
         if pallas_on is None:
             import os
 
+            # nf-lint: disable=trace-safety -- sanctioned A/B knob:
+            # trace-time read baked into the compiled fold; flipping
+            # NF_PALLAS needs a fresh jit cache by design
             pallas_on = os.environ.get("NF_PALLAS", "") == "1"
         if pallas_on:
             import jax

@@ -1,0 +1,54 @@
+"""nf-lint: first-class static analysis for trace-safety, device-sync
+and protocol contracts.
+
+Run it::
+
+    python -m noahgameframe_tpu.lint            # human-readable
+    python -m noahgameframe_tpu.lint --json     # machine-readable
+    scripts/nf_lint.py --rule trace-safety      # one rule only
+
+The engine (``engine.py``) is stdlib-only — no jax import, no device —
+so it runs in CI, hooks and editors.  Rules live in ``rules_*.py`` and
+register here; ``docs/LINT.md`` is the catalog, suppression syntax and
+how-to-add-a-rule guide.
+"""
+
+from __future__ import annotations
+
+from .engine import (  # noqa: F401
+    BAD_SUPPRESSION,
+    Finding,
+    PARSE_ERROR,
+    PackageContext,
+    Report,
+    Rule,
+    UNUSED_SUPPRESSION,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from .rules_contracts import (
+    DrillClocklessRule,
+    FsyncBarrierRule,
+    JournalTapGuardRule,
+    PumpSurfaceRule,
+)
+from .rules_determinism import UnseededRngRule, WallClockRule
+from .rules_trace import RecompileHazardRule, TraceSafetyRule
+from .rules_wire import DispatchHandlerRule, StructCodecRule
+
+#: every shipped rule, in catalog order (docs/LINT.md mirrors this)
+ALL_RULES = (
+    WallClockRule,
+    UnseededRngRule,
+    PumpSurfaceRule,
+    FsyncBarrierRule,
+    DrillClocklessRule,
+    JournalTapGuardRule,
+    TraceSafetyRule,
+    RecompileHazardRule,
+    StructCodecRule,
+    DispatchHandlerRule,
+)
+
+RULES_BY_NAME = {cls.name: cls for cls in ALL_RULES}

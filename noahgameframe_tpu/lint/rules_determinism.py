@@ -1,0 +1,102 @@
+"""Determinism rules: no wall clocks, no unseeded RNGs.
+
+Migrated from the original ``tests/test_determinism_lint.py`` AST
+walker (ISSUE 4) and widened from five hand-picked directories to the
+whole package: record/replay's contract is that device state is a pure
+function of (checkpoint, journaled inputs), and one stray
+``time.time()`` or process-global ``random.random()`` on a tick-adjacent
+path silently breaks every replay.  Intentional wall-clock reads (live
+telemetry stamps, the GuidAllocator's wall mode) carry inline
+suppressions with reasons instead of being invisible to the scan.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Rule, dotted_name
+
+
+class _AliasTracker(ast.NodeVisitor):
+    """Per-file import-alias bookkeeping shared by both rules."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.time_aliases = set()  # modules: import time [as _t]
+        self.time_fn_aliases = set()  # names: from time import time [as t]
+        self.random_aliases = set()  # modules: import random [as _r]
+        self.numpy_aliases = set()  # modules: import numpy [as np]
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = a.asname or a.name
+            if a.name == "time":
+                self.time_aliases.add(name)
+            elif a.name == "random":
+                self.random_aliases.add(name)
+            elif a.name == "numpy":
+                self.numpy_aliases.add(name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "time":
+            for a in node.names:
+                if a.name == "time":
+                    self.time_fn_aliases.add(a.asname or a.name)
+        self.generic_visit(node)
+
+
+class WallClockRule(_AliasTracker, Rule):
+    """``time.time()`` under any import alias."""
+
+    name = "wall-clock"
+    description = ("No time.time() reads: monotonic()/perf_counter() are "
+                   "the injectable-now patterns; wall time in a journaled "
+                   "input or compiled path breaks bit-identical replay.")
+
+    def check_module(self, module, ctx):
+        self.time_aliases = set()
+        self.time_fn_aliases = set()
+        self.random_aliases = set()
+        self.numpy_aliases = set()
+        self.visit(module.tree)
+
+    def visit_Call(self, node):
+        dotted = dotted_name(node.func)
+        if dotted is not None:
+            parts = dotted.split(".")
+            if dotted in self.time_fn_aliases:
+                self.flag(node, f"wall clock read: {dotted}()")
+            elif parts[0] in self.time_aliases and parts[1:] == ["time"]:
+                self.flag(node, f"wall clock read: {dotted}()")
+        self.generic_visit(node)
+
+
+class UnseededRngRule(_AliasTracker, Rule):
+    """Module-global ``random.*`` / unseeded numpy generators."""
+
+    name = "unseeded-rng"
+    description = ("No process-global random.* calls and no unseeded "
+                   "np.random generators: all randomness flows from an "
+                   "explicit seed so replays reproduce it.")
+
+    def check_module(self, module, ctx):
+        self.time_aliases = set()
+        self.time_fn_aliases = set()
+        self.random_aliases = set()
+        self.numpy_aliases = set()
+        self.visit(module.tree)
+
+    def visit_Call(self, node):
+        dotted = dotted_name(node.func)
+        if dotted is not None:
+            parts = dotted.split(".")
+            head, rest = parts[0], parts[1:]
+            if head in self.random_aliases and len(rest) == 1:
+                if not (rest[0] == "Random" and node.args):
+                    self.flag(node, f"process-global RNG: {dotted}()")
+            elif (head in self.numpy_aliases and len(rest) == 2
+                  and rest[0] == "random"):
+                if not (rest[1] == "default_rng" and node.args):
+                    self.flag(node, f"unseeded numpy RNG: {dotted}()")
+        self.generic_visit(node)

@@ -1,0 +1,310 @@
+"""Compiled-side rules: trace-safety and recompile hazards.
+
+Both walk the jit-reachable call graph from :mod:`.callgraph` — the set
+of functions that can run inside (or at trace time of) the compiled
+tick — because that is where a stray host sync or data-dependent shape
+silently destroys the perf and replay contracts the repo is built on.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .callgraph import TracedFunc, jit_sites, traced_reachable
+from .engine import Finding, PackageContext, Rule, dotted_name
+
+#: names whose appearance in an enclosing ``if`` test sanctions a host
+#: sync: the stage clock's honest-device-timing span (NF_STAGE_TIMING)
+_SANCTION_MARKERS = ("stage_timing", "NF_STAGE_TIMING")
+
+_SYNC_LEAVES = {"block_until_ready", "device_get"}
+_SHAPE_FNS = {"arange", "zeros", "ones", "full", "empty", "linspace"}
+_SCALAR_ANNOTATIONS = {"int", "float", "bool", "str", "list", "tuple",
+                       "dict", "List", "Tuple", "Dict", "Sequence"}
+
+
+def _scalar_declared(arg: ast.arg) -> bool:
+    """A param annotated as a Python scalar is a DECLARED host value —
+    converting it at trace time is sizing math, not a device sync (the
+    jit-boundary static check is RecompileHazardRule's job)."""
+    ann = arg.annotation
+    return isinstance(ann, ast.Name) and ann.id in ("int", "float",
+                                                    "bool", "str")
+
+
+def _tainted_names(fn_node) -> Set[str]:
+    """Parameter-rooted names: a cheap tracer proxy.  Params (minus
+    ``self`` and scalar-annotated/scalar-defaulted ones) start tainted;
+    simple assignments propagate to fixpoint."""
+    args = fn_node.args
+    pos = args.posonlyargs + args.args
+    scalar = {a.arg for a in pos + args.kwonlyargs if _scalar_declared(a)}
+    for a, dflt in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+        if isinstance(dflt, ast.Constant) \
+                and isinstance(dflt.value, (int, float, bool, str)):
+            scalar.add(a.arg)
+    for a, dflt in zip(args.kwonlyargs, args.kw_defaults):
+        if isinstance(dflt, ast.Constant) \
+                and isinstance(dflt.value, (int, float, bool, str)):
+            scalar.add(a.arg)
+    names = {a.arg for a in (pos + args.kwonlyargs)} - scalar
+    names |= {a.arg for a in (args.vararg, args.kwarg) if a is not None}
+    names.discard("self")
+    if isinstance(fn_node, ast.Lambda):
+        return names
+    for _ in range(8):
+        grew = False
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Assign):
+                continue
+            rhs_tainted = any(
+                isinstance(x, ast.Name) and x.id in names
+                for x in ast.walk(node.value))
+            if not rhs_tainted:
+                continue
+            for tgt in node.targets:
+                for x in ast.walk(tgt):
+                    if isinstance(x, ast.Name) and x.id not in names:
+                        names.add(x.id)
+                        grew = True
+        if not grew:
+            break
+    return names
+
+
+def _param_rooted(expr, tainted: Set[str]) -> bool:
+    for x in ast.walk(expr):
+        if isinstance(x, ast.Name) and x.id in tainted:
+            return True
+    return False
+
+
+class _TracedScan(ast.NodeVisitor):
+    """Shared traced-function walker with NF_STAGE_TIMING sanctioning."""
+
+    def __init__(self, rule: Rule, tf: TracedFunc):
+        self.rule = rule
+        self.tf = tf
+        self.tainted = _tainted_names(tf.info.node)
+        self._sanction_depth = 0
+
+    def scan(self) -> None:
+        node = self.tf.info.node
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for stmt in body:
+            self.visit(stmt)
+
+    def _sanctioned_test(self, test) -> bool:
+        for x in ast.walk(test):
+            if isinstance(x, ast.Name) and any(
+                    m in x.id for m in _SANCTION_MARKERS):
+                return True
+            if isinstance(x, ast.Attribute) and any(
+                    m in x.attr for m in _SANCTION_MARKERS):
+                return True
+            if isinstance(x, ast.Constant) and isinstance(x.value, str) \
+                    and "NF_STAGE_TIMING" in x.value:
+                return True
+        return False
+
+    def visit_If(self, node):
+        if self._sanctioned_test(node.test):
+            self._sanction_depth += 1
+            for stmt in node.body:
+                self.visit(stmt)
+            self._sanction_depth -= 1
+            for stmt in node.orelse:
+                self.visit(stmt)
+        else:
+            self.generic_visit(node)
+
+    def visit_With(self, node):
+        if any(self._sanctioned_test(item.context_expr)
+               for item in node.items):
+            self._sanction_depth += 1
+            self.generic_visit(node)
+            self._sanction_depth -= 1
+        else:
+            self.generic_visit(node)
+
+    # nested defs are separate reachability nodes; do not double-scan
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @property
+    def sanctioned(self) -> bool:
+        return self._sanction_depth > 0
+
+    def where(self) -> str:
+        return f"in jit-reachable `{self.tf.info.qual}` (root: {self.tf.via})"
+
+
+class _TraceSafetyScan(_TracedScan):
+    def visit_Call(self, node):
+        d = dotted_name(node.func)
+        leaf = d.split(".")[-1] if d else (
+            node.func.attr if isinstance(node.func, ast.Attribute) else "")
+        if leaf in _SYNC_LEAVES:
+            if not self.sanctioned:
+                self.rule.flag(node, f"host sync `{leaf}` {self.where()} — "
+                               "outside the sanctioned NF_STAGE_TIMING span",
+                               path=self.tf.info.rel)
+        elif leaf == "item" and not node.args:
+            if not self.sanctioned:
+                self.rule.flag(node, f"`.item()` forces a device->host "
+                               f"transfer {self.where()}",
+                               path=self.tf.info.rel)
+        elif d == "print" and not self.sanctioned:
+            self.rule.flag(node, f"`print` {self.where()} — host I/O "
+                           "inside the compiled tick path",
+                           path=self.tf.info.rel)
+        elif leaf in ("asarray", "array") and d is not None \
+                and d.split(".")[0] in ("np", "numpy", "onp"):
+            if node.args and _param_rooted(node.args[0], self.tainted) \
+                    and not self.sanctioned:
+                self.rule.flag(node, "`np." + leaf + "` on a traced value "
+                               f"{self.where()} — forces a host readback",
+                               path=self.tf.info.rel)
+        elif d in ("float", "int") and node.args \
+                and isinstance(node.args[0], (ast.Name, ast.Attribute,
+                                              ast.Subscript)) \
+                and _param_rooted(node.args[0], self.tainted) \
+                and not self.sanctioned:
+            # direct conversion of a param-rooted value only: wrapped
+            # host math (int(math.ceil(...)), int(round(...))) yields a
+            # Python scalar already and is trace-time sizing, not a sync
+            self.rule.flag(node, f"`{d}()` on a traced value "
+                           f"{self.where()} — concretizes (host sync)",
+                           path=self.tf.info.rel)
+        elif leaf == "getenv" or (d is not None and ".environ" in f".{d}."):
+            self.rule.flag(node, f"os.environ read {self.where()} — config "
+                           "is a setup-time input, not a trace-time one",
+                           path=self.tf.info.rel)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        d = dotted_name(node.value)
+        if d is not None and d.split(".")[-1] == "environ":
+            # bare os.environ[...] access (no .get call)
+            self.rule.flag(node, f"os.environ read {self.where()} — config "
+                           "is a setup-time input, not a trace-time one",
+                           path=self.tf.info.rel)
+        self.generic_visit(node)
+
+
+class TraceSafetyRule(Rule):
+    """Host-sync escapes inside the jit-reachable call graph."""
+
+    name = "trace-safety"
+    description = (
+        "No block_until_ready / device_get / .item() / np.asarray(traced) "
+        "/ print / os.environ reads in jit-reachable code outside the "
+        "sanctioned NF_STAGE_TIMING span.")
+    per_module = False
+
+    def run_package(self, ctx: PackageContext) -> List[Finding]:
+        self.findings = []
+        for tf in traced_reachable(ctx).values():
+            if tf.info.rel not in ctx.modules:
+                continue
+            self.module = ctx.modules[tf.info.rel]
+            _TraceSafetyScan(self, tf).scan()
+        return self.findings
+
+
+class _RecompileScan(_TracedScan):
+    def visit_Call(self, node):
+        d = dotted_name(node.func)
+        leaf = d.split(".")[-1] if d else (
+            node.func.attr if isinstance(node.func, ast.Attribute) else "")
+        if leaf == "tolist" and not node.args:
+            self.rule.flag(node, f"`.tolist()` {self.where()} — "
+                           "concretizes and feeds Python containers back "
+                           "into the trace (retrace per distinct value)",
+                           path=self.tf.info.rel)
+        elif leaf in _SHAPE_FNS:
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                for x in ast.walk(a):
+                    if isinstance(x, ast.Call) \
+                            and isinstance(x.func, ast.Name) \
+                            and x.func.id == "len" and x.args \
+                            and _param_rooted(x.args[0], self.tainted):
+                        self.rule.flag(
+                            node, f"data-dependent shape: `{leaf}(len(...))`"
+                            f" {self.where()} — every distinct length is a "
+                            "fresh trace+compile",
+                            path=self.tf.info.rel)
+        self.generic_visit(node)
+
+
+class RecompileHazardRule(Rule):
+    """Retrace traps: undeclared-static Python scalars at jit boundaries
+    and data-dependent shapes inside the trace."""
+
+    name = "recompile-hazard"
+    description = (
+        "jitted functions must declare Python-scalar/container params "
+        "static; no .tolist()/len()-derived shapes in traced code.")
+    per_module = False
+
+    def run_package(self, ctx: PackageContext) -> List[Finding]:
+        self.findings = []
+        # (a) jit boundary: scalar-typed params not declared static
+        for site in jit_sites(ctx):
+            if site.kind != "jit":
+                continue
+            for fi in site.direct_targets:
+                if isinstance(fi.node, ast.Lambda):
+                    continue
+                self.module = ctx.modules.get(fi.rel)
+                if self.module is None:
+                    continue
+                self._check_params(site, fi)
+        # (b) traced interior: data-dependent shapes
+        for tf in traced_reachable(ctx).values():
+            if tf.info.rel not in ctx.modules:
+                continue
+            self.module = ctx.modules[tf.info.rel]
+            _RecompileScan(self, tf).scan()
+        return self.findings
+
+    def _check_params(self, site, fi) -> None:
+        args = fi.node.args
+        params = args.posonlyargs + args.args
+        offset = 0
+        if params and params[0].arg == "self":
+            params = params[1:]  # bound method: self never reaches jit
+        defaults = list(args.defaults)
+        # align defaults to the tail of params
+        dmap = {}
+        for p, dflt in zip(params[len(params) - len(defaults):], defaults):
+            dmap[p.arg] = dflt
+        for pos, p in enumerate(params):
+            if pos + offset in site.static_argnums \
+                    or p.arg in site.static_argnames:
+                continue
+            ann = p.annotation
+            ann_name = None
+            if isinstance(ann, ast.Name):
+                ann_name = ann.id
+            elif isinstance(ann, ast.Subscript) \
+                    and isinstance(ann.value, ast.Name):
+                ann_name = ann.value.id
+            hazard = None
+            if ann_name in _SCALAR_ANNOTATIONS:
+                hazard = f"param `{p.arg}: {ann_name}`"
+            elif p.arg in dmap and isinstance(dmap[p.arg], ast.Constant) \
+                    and isinstance(dmap[p.arg].value, (int, float, bool,
+                                                       str)) \
+                    and not isinstance(dmap[p.arg].value, type(None)):
+                hazard = (f"param `{p.arg}` defaulting to Python scalar "
+                          f"{dmap[p.arg].value!r}")
+            if hazard:
+                self.flag(fi.node,
+                          f"jitted `{fi.qual}` (site {site.rel}:"
+                          f"{site.lineno}): {hazard} is not declared "
+                          "static — every distinct value retraces",
+                          path=fi.rel)

@@ -31,7 +31,7 @@ world drives the same `SWITCH_SERVER_DATA` / `REQ_SWITCH_SERVER` /
 
 Thread contract: everything here runs on the owning role's pump thread.
 No sleeps, no blocking I/O on the parking path — enforced structurally
-by tests/test_determinism_lint.py.
+by the nf-lint ``pump-surface`` rule (docs/LINT.md).
 """
 
 from __future__ import annotations

@@ -403,8 +403,8 @@ class ProxyRole(ServerRole):
         self._parking_pump(now)
 
     def _parking_pump(self, now: float) -> None:
-        """Per-pump parking maintenance — strictly non-blocking (lint
-        contract, tests/test_determinism_lint.py): retry replay for
+        """Per-pump parking maintenance — strictly non-blocking (nf-lint
+        `pump-surface` contract, docs/LINT.md): retry replay for
         sessions whose binding healed without a switch-route (e.g. the
         origin game revived on the same id), expire deadline-overdue
         frames, and tell affected clients what was lost."""

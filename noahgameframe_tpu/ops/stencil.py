@@ -66,6 +66,9 @@ def binning_mode() -> str:
     silently running the default would invalidate any A/B it labeled.
     This is the ONLY place the env var is read (pinned by
     tests/test_binning.py's lint guard)."""
+    # nf-lint: disable=trace-safety -- sanctioned A/B knob: read once at
+    # trace time and baked into the compiled tick; tests pin this as the
+    # only NF_BINNING read and flipping it requires a fresh jit cache
     raw = os.environ.get(ENV_BINNING, "").strip()
     if not raw:
         return "sort"
@@ -233,6 +236,8 @@ def _sorted_segments(pos, active, cell_size: float, width: int,
     n_cells, key = _cell_keys(
         pos, active, cell_size, width, cell=cell, n_cells=n_cells
     )
+    # nf-lint: disable=trace-safety -- sanctioned A/B knob: trace-time
+    # read baked into the compilation; flipping needs a fresh jit cache
     radix = os.environ.get("NF_RADIX", "")
     if radix.isdigit() and int(radix) > 0:
         # NF_RADIX=<bits per pass>: 1 = binary partition passes,

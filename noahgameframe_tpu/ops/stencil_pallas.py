@@ -127,6 +127,8 @@ def combat_fold_pallas(vic_table, att_table, radius: float, interpret: bool = Fa
 
     width = vic_table.width
     assert att_table.width == width and att_table.cell_size == vic_table.cell_size
+    # nf-lint: disable=trace-safety -- sanctioned A/B knob: trace-time
+    # read baked into the compilation; flipping needs a fresh jit cache
     align = int(os.environ.get("NF_PALLAS_ALIGN", "0") or 0)
     w_pad = ((-width) % align) if align > 1 else 0
     vic = _planes(vic_table.payload, width, vic_table.bucket, N_VFEATS,

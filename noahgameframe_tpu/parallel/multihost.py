@@ -135,9 +135,9 @@ def rendezvous_via_master(
     expected host has arrived.  Returns (coordinator, num_processes,
     process_id) ready to hand to init_distributed."""
     base = f"http://{master_http}/dist?host={host_key}&coord={coord_endpoint}"
-    deadline = time.time() + timeout_s
+    deadline = time.monotonic() + timeout_s
     assignment = None
-    while time.time() < deadline:
+    while time.monotonic() < deadline:
         with urllib.request.urlopen(base, timeout=5) as r:
             assignment = json.loads(r.read())
         if "error" in assignment:

@@ -46,9 +46,9 @@ Robustness model, in order of defense:
 
 Thread contract: ``enqueue``/``note_tick``/``barrier``/``pump``/
 ``pending``/``discard`` are pump-thread calls and never touch the
-store; the flusher thread owns every backend call.  The determinism
-lint (tests/test_determinism_lint.py) enforces both properties
-structurally.
+store; the flusher thread owns every backend call.  The nf-lint
+``pump-surface`` and ``fsync-barrier`` rules (docs/LINT.md) enforce
+both properties structurally.
 """
 
 from __future__ import annotations

@@ -25,8 +25,8 @@ ISSUE 7 (frame observatory).  Three cooperating pieces:
   estimate (bias = one-way network delay of the luckiest sample).
 
 Nothing here may feed the journal, the state digest, or any compiled
-function — ``tests/test_determinism_lint.py`` scans this file and the
-wire path for wall-clock leaks, and ``tests/test_pipeline.py`` proves a
+function — the nf-lint ``wall-clock`` rule scans this file and the
+wire path for wall-clock leaks (docs/LINT.md), and ``tests/test_pipeline.py`` proves a
 journaled run replays bit-identically with tracing on.
 """
 

@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""nf-lint CLI wrapper — `scripts/nf_lint.py --json` exits non-zero on
+any unsuppressed finding (CI gate; tier-1 runs the same engine through
+tests/test_lint.py).  All flags forward to
+`python -m noahgameframe_tpu.lint`; see docs/LINT.md."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from noahgameframe_tpu.lint.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,13 @@
+"""recompile-hazard violations: undeclared-static scalar at the jit
+boundary, data-dependent shape, and .tolist() inside the trace."""
+import jax
+import jax.numpy as jnp
+
+
+def _tick(xs, n: int):
+    idx = jnp.arange(len(xs))  # every distinct length retraces
+    host = xs.tolist()  # concretizes + feeds containers back in
+    return idx, host, n
+
+
+step = jax.jit(_tick)

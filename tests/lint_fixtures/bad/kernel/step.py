@@ -1,0 +1,23 @@
+"""trace-safety violations: every host-sync escape class, reached
+through one call-graph hop from a jitted entrypoint."""
+import os
+
+import jax
+import numpy as np
+
+
+def _helper(state):
+    state.block_until_ready()  # host sync
+    print("tick", state)  # host I/O in the compiled path
+    level = os.environ.get("NF_LEVEL", "")  # trace-time config read
+    hp = float(state)  # concretizes a traced value
+    raw = state.item()  # device->host transfer
+    host = np.asarray(state)  # host readback
+    return state, level, hp, raw, host
+
+
+def _tick(state):
+    return _helper(state)
+
+
+step = jax.jit(_tick)

@@ -1,0 +1,16 @@
+"""journal-tap-guard violation: trace sidecars reach the journal."""
+
+TRACE_MSG_IDS = frozenset({900, 901})
+
+
+class GameRole:
+    def __init__(self, journal):
+        self.journal = journal
+
+    def _journal_tap(self):
+        def tap(conn_id, msg_id, payload):
+            # unguarded: FRAME_TRACE sidecars enter the journal and
+            # replay diverges between traced and untraced runs
+            self.journal.event(conn_id, msg_id, payload)
+
+        return tap

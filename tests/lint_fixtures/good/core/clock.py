@@ -1,0 +1,24 @@
+"""Clean counterpart: injectable-now patterns and seeded RNGs."""
+import random
+import time
+
+import numpy as np
+
+
+def stamp():
+    return time.monotonic()  # injectable-now pattern, not wall time
+
+
+def shuffle(items, seed: int):
+    random.Random(seed).shuffle(items)  # seeded instance
+    return items
+
+
+def noise(n, seed: int):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=n)
+
+
+def annotate(rng: np.random.Generator):
+    """Attribute load in an annotation, not a call — must pass."""
+    return rng

@@ -1,0 +1,12 @@
+"""Clean jit boundary: the scalar is declared static, shapes come from
+.shape, nothing concretizes."""
+import jax
+import jax.numpy as jnp
+
+
+def _tick(xs, n: int):
+    idx = jnp.arange(xs.shape[0])
+    return idx[:n]
+
+
+step = jax.jit(_tick, static_argnames=("n",))

@@ -1,0 +1,18 @@
+"""Clean traced step: the only host sync sits inside the sanctioned
+stage-timing span."""
+import jax
+import numpy as np
+
+
+def _helper(state, stage_timing: bool = False):
+    if stage_timing:
+        state.block_until_ready()  # honest device timing, sanctioned
+    return state
+
+
+def _tick(state):
+    base = np.zeros(4)  # numpy on static setup data is fine
+    return _helper(state), base
+
+
+step = jax.jit(_tick)

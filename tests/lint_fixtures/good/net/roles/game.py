@@ -1,0 +1,15 @@
+"""Clean journal tap: sidecar opcodes filtered before the journal."""
+
+TRACE_MSG_IDS = frozenset({900, 901})
+
+
+class GameRole:
+    def __init__(self, journal):
+        self.journal = journal
+
+    def _journal_tap(self):
+        def tap(conn_id, msg_id, payload):
+            if msg_id not in TRACE_MSG_IDS:
+                self.journal.event(conn_id, msg_id, payload)
+
+        return tap

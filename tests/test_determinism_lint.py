@@ -1,155 +1,71 @@
-"""Determinism lint (ISSUE 4 satellite): the simulation layers must not
-read wall clocks or unseeded RNGs.
+"""Determinism + structural contract lints, now a thin wrapper over the
+nf-lint engine (ISSUE 12; originally a test-embedded AST walker from
+ISSUEs 4/6/7/10/11).
 
-Record/replay's whole contract is that device state is a pure function
-of (checkpoint, journaled inputs).  One stray ``time.time()`` or global
-``random.random()`` in a tick-path module silently breaks every replay,
-so this test walks the AST of ``kernel/``, ``ops/`` and ``game/`` and
-fails on:
+The checks themselves live in ``noahgameframe_tpu/lint/`` as named
+rules — ``wall-clock``, ``unseeded-rng``, ``pump-surface``,
+``fsync-barrier``, ``drill-clockless``, ``journal-tap-guard`` — and the
+scan is WIDER than the old five-directory allowlist: the whole package,
+with intentional reads carrying inline ``# nf-lint: disable=... -- ...``
+waivers.  This file keeps two guarantees alive across that migration:
 
-- ``time.time()`` calls, under any import alias (``import time as _t``,
-  ``from time import time``),
-- module-level ``random.*`` calls (the process-global RNG) — seeded
-  instance construction ``random.Random(seed)`` is fine,
-- ``np.random.*`` calls except ``np.random.default_rng(seed...)`` with
-  an explicit seed argument; references to ``np.random.Generator`` in
-  annotations are attribute loads, not calls, and pass.
+- every offense class the legacy linter caught is still caught (the
+  meta-test snippets below are the original corpus, verbatim), and
+- the real tree stays clean under the migrated rules.
 
-Methods on a seeded generator object (``rng.normal()``) are untouched:
-only *module*-rooted calls are nondeterministic by construction.
+Per-rule fixtures and engine-protocol tests live in tests/test_lint.py.
 """
 
-import ast
 from pathlib import Path
 
 import pytest
 
+from noahgameframe_tpu.lint import run_lint
+from noahgameframe_tpu.lint.rules_contracts import (
+    DrillClocklessRule,
+    FsyncBarrierRule,
+    JournalTapGuardRule,
+    PumpSurfaceRule,
+)
+from noahgameframe_tpu.lint.rules_determinism import (
+    UnseededRngRule,
+    WallClockRule,
+)
+
 PKG = Path(__file__).resolve().parent.parent / "noahgameframe_tpu"
-# persist/ rides along (ISSUE 6): write-behind batch identity (seq, tick)
-# must never include a wall clock — recovery flushes have to be
-# byte-identical to the flushes a crash interrupted
-# drill/ rides along (ISSUE 11): campaign scheduling is tick-indexed by
-# contract — a wall clock in a schedule or invariant would turn a
-# repeatable game-day drill back into an anecdote
-SCANNED_DIRS = ("kernel", "ops", "game", "persist", "drill")
-# frame observatory (ISSUE 7): the stage clock and the trace wire path
-# (game emit/ack, proxy stamp, client echo) stamp with perf_counter_ns —
-# fine — but a time.time() anywhere on these paths could leak wall clock
-# into journaled inputs or compiled functions, so they join the scan
-EXTRA_FILES = (
-    "telemetry/pipeline.py",
-    "net/roles/base.py",
-    "net/roles/game.py",
-    "net/roles/proxy.py",
-    "client/sdk.py",
-    # session failover (ISSUE 10): park/replay decisions are journaled
-    # inputs downstream (the frames they order feed game handlers), and
-    # the driver's retry/deadline arithmetic runs on injected `now` —
-    # a wall clock here would make re-homes non-reproducible
-    "net/failover.py",
-)
+
+DETERMINISM_RULES = (WallClockRule, UnseededRngRule)
+CONTRACT_RULES = (PumpSurfaceRule, FsyncBarrierRule, DrillClocklessRule,
+                  JournalTapGuardRule)
 
 
-def _files():
-    for d in SCANNED_DIRS:
-        yield from sorted((PKG / d).rglob("*.py"))
-    for f in EXTRA_FILES:
-        yield PKG / f
+def _snippet(src: str, rules, tmp_path, rel="game/_lint_probe.py"):
+    """Open findings for a synthetic module injected at ``rel``."""
+    report = run_lint(tmp_path, rules=list(rules), overrides={rel: src})
+    return [f for f in report.open_findings if f.path == rel]
 
 
-def _dotted(node):
-    """Attribute/Name chain as a dotted string ('np.random.normal'),
-    or None for anything dynamic."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
+# --- the real tree stays clean under the migrated (and widened) rules ----
+
+def test_no_nondeterminism_in_package():
+    report = run_lint(PKG, rules=list(DETERMINISM_RULES))
+    offenses = [f"{f.path}:{f.line}: {f.message}"
+                for f in report.open_findings]
+    assert not offenses, "\n".join(offenses)
 
 
-class _Linter(ast.NodeVisitor):
-    def __init__(self, path: Path):
-        self.path = path
-        self.offenses = []
-        # alias maps rebuilt per file from its own imports
-        self.time_aliases = set()  # modules: import time [as _t]
-        self.time_fn_aliases = set()  # names: from time import time [as t]
-        self.random_aliases = set()  # modules: import random [as _r]
-        self.numpy_aliases = set()  # modules: import numpy [as np]
-
-    def _flag(self, node, what):
-        self.offenses.append(
-            f"{self.path.relative_to(PKG.parent)}:{node.lineno}: {what}"
-        )
-
-    def visit_Import(self, node):
-        for a in node.names:
-            name = a.asname or a.name
-            if a.name == "time":
-                self.time_aliases.add(name)
-            elif a.name == "random":
-                self.random_aliases.add(name)
-            elif a.name == "numpy":
-                self.numpy_aliases.add(name)
-        self.generic_visit(node)
-
-    def visit_ImportFrom(self, node):
-        if node.module == "time":
-            for a in node.names:
-                if a.name == "time":
-                    self.time_fn_aliases.add(a.asname or a.name)
-        self.generic_visit(node)
-
-    def visit_Call(self, node):
-        dotted = _dotted(node.func)
-        if dotted is not None:
-            self._check_call(node, dotted)
-        self.generic_visit(node)
-
-    def _check_call(self, node, dotted):
-        parts = dotted.split(".")
-        head, rest = parts[0], parts[1:]
-        if dotted in self.time_fn_aliases:
-            self._flag(node, f"wall clock read: {dotted}()")
-        elif head in self.time_aliases and rest == ["time"]:
-            self._flag(node, f"wall clock read: {dotted}()")
-        elif head in self.random_aliases and len(rest) == 1:
-            if rest[0] == "Random" and node.args:
-                return  # seeded instance
-            self._flag(node, f"process-global RNG: {dotted}()")
-        elif (head in self.numpy_aliases and len(rest) == 2
-              and rest[0] == "random"):
-            if rest[1] == "default_rng" and node.args:
-                return  # explicitly seeded generator
-            self._flag(node, f"unseeded numpy RNG: {dotted}()")
-
-
-def _lint(path: Path):
-    linter = _Linter(path)
-    linter.visit(ast.parse(path.read_text(), filename=str(path)))
-    return linter.offenses
-
-
-@pytest.mark.parametrize(
-    "path", list(_files()),
-    ids=lambda p: str(p.relative_to(PKG)),
-)
-def test_no_nondeterminism_in_tick_layers(path):
-    offenses = _lint(path)
+@pytest.mark.parametrize("rule_cls", CONTRACT_RULES,
+                         ids=lambda c: c.name)
+def test_structural_contracts_hold(rule_cls):
+    report = run_lint(PKG, rules=[rule_cls])
+    offenses = [f"{f.path}:{f.line}: {f.message}"
+                for f in report.open_findings]
     assert not offenses, "\n".join(offenses)
 
 
 # --- the linter itself must catch what it claims to (meta-tests on
-# synthetic sources, so a refactor can't silently blunt the lint)
-def _lint_source(src: str, tmp_path) -> list:
-    f = PKG / "game" / "_lint_probe.py"  # relative_to(PKG.parent) must work
-    linter = _Linter(f)
-    linter.visit(ast.parse(src))
-    return linter.offenses
-
+# synthetic sources, so a refactor can't silently blunt the lint).
+# This corpus is the original test-embedded linter's, verbatim.
 
 @pytest.mark.parametrize("src", [
     "import time\ntime.time()",
@@ -164,7 +80,7 @@ def _lint_source(src: str, tmp_path) -> list:
     "import numpy\nnumpy.random.normal()",
 ])
 def test_linter_catches(src, tmp_path):
-    assert _lint_source(src, tmp_path), src
+    assert _snippet(src, DETERMINISM_RULES, tmp_path), src
 
 
 @pytest.mark.parametrize("src", [
@@ -175,230 +91,73 @@ def test_linter_catches(src, tmp_path):
     "import numpy as np\nnp.arange(4)",
 ])
 def test_linter_allows(src, tmp_path):
-    assert not _lint_source(src, tmp_path), src
+    assert not _snippet(src, DETERMINISM_RULES, tmp_path), src
 
 
-# --- write-behind thread contract (ISSUE 6): the pump-thread surface of
-# WriteBehindPipeline must never touch the store or sleep — the compiled
-# tick cannot be allowed to block on a socket — and only barrier/drain/
-# close may fsync the WAL (enqueue/pump run every tick; an fsync there
-# would put disk latency on the tick path).
-WB_PATH = PKG / "persist" / "writebehind.py"
-PUMP_METHODS = {"enqueue", "enqueue_one", "note_tick", "barrier", "pump",
-                "pending", "discard", "lag_ticks", "queue_depth",
-                "degraded"}
-SYNC_ALLOWED = {"barrier", "drain", "close", "kill"}
+# --- contract meta-tests: a mutated module at the scoped path must flag
+
+_WB_BAD_PUMP = """\
+class WriteBehindPipeline:
+    def enqueue(self, batch):
+        self.backend.put_many(batch)
+    def enqueue_one(self, rec): pass
+    def note_tick(self, tick): pass
+    def barrier(self): pass
+    def pump(self): pass
+    def pending(self): pass
+    def discard(self): pass
+    def lag_ticks(self): pass
+    def queue_depth(self): pass
+    def degraded(self): pass
+    def _flush_batch(self, batch):
+        self.backend.put_many(batch)
+"""
+
+_WB_BAD_FSYNC = _WB_BAD_PUMP.replace(
+    "    def note_tick(self, tick): pass",
+    "    def note_tick(self, tick):\n        self.wal.sync()")
 
 
-def _pipeline_methods():
-    tree = ast.parse(WB_PATH.read_text(), filename=str(WB_PATH))
-    cls = next(
-        n for n in tree.body
-        if isinstance(n, ast.ClassDef) and n.name == "WriteBehindPipeline"
+def test_pump_surface_rule_catches_store_on_pump(tmp_path):
+    found = _snippet(_WB_BAD_PUMP, [PumpSurfaceRule], tmp_path,
+                     rel="persist/writebehind.py")
+    assert any("store/sleep" in f.message for f in found)
+
+
+def test_pump_surface_rule_catches_vanished_class(tmp_path):
+    found = _snippet("x = 1\n", [PumpSurfaceRule], tmp_path,
+                     rel="persist/writebehind.py")
+    assert any("vanished" in f.message for f in found)
+
+
+def test_fsync_rule_catches_per_tick_sync(tmp_path):
+    found = _snippet(_WB_BAD_FSYNC, [FsyncBarrierRule], tmp_path,
+                     rel="persist/writebehind.py")
+    assert any("fsync" in f.message for f in found)
+
+
+def test_drill_rule_catches_clocked_schedule(tmp_path):
+    found = _snippet("import time\nT = time.monotonic()\n",
+                     [DrillClocklessRule], tmp_path,
+                     rel="drill/schedule.py")
+    assert found
+
+
+def test_drill_rule_allows_runner_pacing(tmp_path):
+    found = _snippet("import time\ntime.sleep(time.monotonic() % 1)\n",
+                     [DrillClocklessRule], tmp_path,
+                     rel="drill/runner.py")
+    assert not found
+
+
+def test_journal_tap_rule_catches_unguarded_write(tmp_path):
+    src = (
+        "class GameRole:\n"
+        "    def _journal_tap(self):\n"
+        "        def tap(conn_id, msg_id, payload):\n"
+        "            self.journal.event(conn_id, msg_id, payload)\n"
+        "        return tap\n"
     )
-    return {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
-
-
-def _calls(fn):
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            dotted = _dotted(node.func)
-            if dotted is not None:
-                yield node.lineno, dotted
-
-
-def test_pipeline_declares_expected_pump_surface():
-    missing = PUMP_METHODS - set(_pipeline_methods())
-    assert not missing, f"pump-thread methods vanished: {sorted(missing)}"
-
-
-@pytest.mark.parametrize("method", sorted(PUMP_METHODS))
-def test_pump_surface_never_touches_store_or_sleeps(method):
-    fn = _pipeline_methods()[method]
-    offenses = [
-        f"{method}:{line}: {dotted}"
-        for line, dotted in _calls(fn)
-        if dotted.startswith("self.backend.")
-        or dotted == "self._flush_batch"
-        or dotted.endswith(".sleep") or dotted == "sleep"
-    ]
-    assert not offenses, (
-        "store/sleep call on the pump-thread surface:\n" + "\n".join(offenses)
-    )
-
-
-def test_wal_fsync_only_at_barriers():
-    for name, fn in _pipeline_methods().items():
-        if name in SYNC_ALLOWED:
-            continue
-        offenses = [
-            f"{name}:{line}" for line, dotted in _calls(fn)
-            if dotted in ("self.wal.sync", "os.fsync")
-        ]
-        assert not offenses, (
-            "per-tick WAL fsync (disk latency on the tick path):\n"
-            + "\n".join(offenses)
-        )
-
-
-def test_flusher_owns_every_store_call():
-    methods = _pipeline_methods()
-    callers = {
-        name for name, fn in methods.items()
-        if any(dotted.startswith("self.backend.")
-               for _, dotted in _calls(fn))
-    }
-    # _flush_batch (called only from _run, the flusher thread) is the
-    # single place store I/O happens
-    assert callers == {"_flush_batch"}, callers
-
-
-# --- trace journal-exclusion contract (ISSUE 7): replay bit-identity
-# with tracing on vs off requires that FRAME_TRACE / FRAME_TRACE_ACK
-# events never enter the journal — the recorded input stream must not
-# depend on whether a session was sampled.  Enforced structurally: the
-# journal tap's write is guarded by a TRACE_MSG_IDS membership test.
-GAME_PATH = PKG / "net" / "roles" / "game.py"
-
-
-def _journal_tap_fn():
-    tree = ast.parse(GAME_PATH.read_text(), filename=str(GAME_PATH))
-    cls = next(n for n in tree.body
-               if isinstance(n, ast.ClassDef) and n.name == "GameRole")
-    outer = next(n for n in cls.body
-                 if isinstance(n, ast.FunctionDef)
-                 and n.name == "_journal_tap")
-    return next(n for n in ast.walk(outer)
-                if isinstance(n, ast.FunctionDef) and n.name == "tap")
-
-
-def _class_methods(path: Path, class_name: str):
-    tree = ast.parse(path.read_text(), filename=str(path))
-    cls = next(n for n in tree.body
-               if isinstance(n, ast.ClassDef) and n.name == class_name)
-    return {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
-
-
-# --- parking-path thread contract (ISSUE 10): the proxy parks, replays
-# and expires client frames on its dispatch/pump thread — while every
-# OTHER client's traffic waits behind it.  A sleep, a blocking file or
-# store call, or an unbounded busy loop there turns one session's
-# failover stall into a whole-proxy stall.  Enforced structurally, like
-# the write-behind pump surface above.
-FAILOVER_PATH = PKG / "net" / "failover.py"
-PROXY_PATH = PKG / "net" / "roles" / "proxy.py"
-PARKING_METHODS = {"park", "expire", "replay", "discard", "depth", "keys"}
-PROXY_PARKING_SURFACE = {"_parking_pump", "_on_client_message",
-                         "_on_switch_route", "_notify_switch"}
-_BLOCKING = ("sleep", "fsync", "open", "connect", "recv", "accept")
-
-
-def _blocking_calls(fn):
-    for line, dotted in _calls(fn):
-        leaf = dotted.rsplit(".", 1)[-1]
-        if leaf in _BLOCKING:
-            yield f"{fn.name}:{line}: {dotted}"
-
-
-def test_parking_buffer_declares_expected_surface():
-    missing = PARKING_METHODS - set(_class_methods(FAILOVER_PATH,
-                                                   "ParkingBuffer"))
-    assert not missing, f"parking methods vanished: {sorted(missing)}"
-
-
-@pytest.mark.parametrize("method", sorted(PARKING_METHODS))
-def test_parking_buffer_never_blocks(method):
-    fn = _class_methods(FAILOVER_PATH, "ParkingBuffer")[method]
-    offenses = list(_blocking_calls(fn))
-    assert not offenses, (
-        "blocking call inside ParkingBuffer:\n" + "\n".join(offenses)
-    )
-
-
-@pytest.mark.parametrize("method", sorted(PROXY_PARKING_SURFACE))
-def test_proxy_parking_pump_never_blocks(method):
-    methods = _class_methods(PROXY_PATH, "ProxyRole")
-    assert method in methods, f"proxy parking surface lost {method}"
-    offenses = list(_blocking_calls(methods[method]))
-    assert not offenses, (
-        "blocking call on the proxy parking path:\n" + "\n".join(offenses)
-    )
-
-
-# --- drill clock contract (ISSUE 11): campaigns and invariants are
-# tick-indexed, never wall-timed.  Stronger than the RNG/wall-clock lint
-# above: schedule.py and invariants.py must not reference the `time`
-# module AT ALL (even monotonic would smuggle a runtime clock into what
-# is declaratively a tick schedule); runner.py is the single component
-# allowed to touch the clock, and only as pump pacing — monotonic()
-# and sleep(), nothing else.
-DRILL = PKG / "drill"
-DRILL_CLOCKLESS = ("schedule.py", "invariants.py")
-RUNNER_CLOCK_ALLOWED = {"monotonic", "sleep"}
-
-
-def _time_refs(path: Path):
-    """Every dotted use rooted in a `time` import, plus the imports
-    themselves (`import time [as x]` / `from time import ...`)."""
-    tree = ast.parse(path.read_text(), filename=str(path))
-    aliases = set()
-    refs = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name == "time":
-                    aliases.add(a.asname or a.name)
-                    refs.append((node.lineno, "import time"))
-        elif isinstance(node, ast.ImportFrom) and node.module == "time":
-            for a in node.names:
-                refs.append((node.lineno, f"from time import {a.name}"))
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Attribute):
-            dotted = _dotted(node)
-            if dotted is not None and dotted.split(".")[0] in aliases:
-                refs.append((node.lineno, dotted))
-    return refs
-
-
-@pytest.mark.parametrize("fname", DRILL_CLOCKLESS)
-def test_drill_schedule_and_invariants_are_clockless(fname):
-    refs = _time_refs(DRILL / fname)
-    assert not refs, (
-        f"drill/{fname} references the time module — campaign "
-        "schedules/invariants are tick-indexed by contract:\n"
-        + "\n".join(f"  line {ln}: {what}" for ln, what in refs)
-    )
-
-
-def test_drill_runner_clock_is_pacing_only():
-    offenses = [
-        (ln, what) for ln, what in _time_refs(DRILL / "runner.py")
-        if "." in what  # attribute uses; the import line itself is fine
-        and what.split(".")[-1] not in RUNNER_CLOCK_ALLOWED
-    ]
-    assert not offenses, (
-        "drill/runner.py touches the clock beyond monotonic/sleep "
-        "pacing:\n"
-        + "\n".join(f"  line {ln}: {what}" for ln, what in offenses)
-    )
-
-
-def test_journal_tap_excludes_trace_sidecars():
-    tap = _journal_tap_fn()
-    writes = [n for n in ast.walk(tap)
-              if isinstance(n, ast.Call)
-              and _dotted(n.func) is not None
-              and _dotted(n.func).endswith(".event")]
-    assert writes, "journal tap no longer writes events?"
-    guarded = [
-        n for n in ast.walk(tap)
-        if isinstance(n, ast.If)
-        and any(isinstance(x, ast.Name) and x.id == "TRACE_MSG_IDS"
-                for x in ast.walk(n.test))
-        and any(w in ast.walk(n) for w in writes)
-    ]
-    assert guarded, (
-        "journal writes are not guarded by a TRACE_MSG_IDS test — "
-        "trace sidecars would enter the journal and break replay "
-        "identity between traced and untraced runs"
-    )
+    found = _snippet(src, [JournalTapGuardRule], tmp_path,
+                     rel="net/roles/game.py")
+    assert any("TRACE_MSG_IDS" in f.message for f in found)
