@@ -393,6 +393,10 @@ def run_served(args) -> dict:
         world=world,
         cross_server_sync=False,
         interest_radius=args.interest_radius,
+        # store_true flags pass None when absent so NF_SERVE_BATCH /
+        # NF_SERVE_OVERLAP env knobs still decide (A/B harness parity)
+        serve_batch=args.serve_batch or None,
+        serve_overlap=args.serve_overlap or None,
     )
     sent = {"msgs": 0, "bytes": 0}
 
@@ -459,6 +463,8 @@ def run_served(args) -> dict:
             "sync_msgs": sent["msgs"],
             "sync_bytes": sent["bytes"],
             "interest_radius": args.interest_radius,
+            "serve_batch": bool(role.serve_batch),
+            "serve_overlap": bool(role.serve_overlap),
             "device": str(dev),
             "platform": dev.platform,
             "binning": binning_mode(),
@@ -709,6 +715,85 @@ def _served_probe(extra_args=()) -> dict:
     return {"error": f"served probe rc={r.returncode}"}
 
 
+def _run_session_sweep(args) -> dict:
+    """--sweep-sessions: one served measurement per session count (the
+    ISSUE 13 serving-edge scaling curve), each point in a SUBPROCESS so
+    an OOM or wall-clock blowout at the 100k rung can't burn the smaller
+    points.  With --sweep-ab every count also runs the legacy per-session
+    engine first — the before/after `detail.pipeline` waterfall pair the
+    r08 artifact records."""
+    counts = [int(x) for x in args.sweep_sessions.split(",") if x.strip()]
+    radius = 8.0 if args.interest_radius is None else args.interest_radius
+
+    def one(sessions: int, serve_batch: bool) -> dict:
+        cmd = [
+            sys.executable, "-u", __file__,
+            "--served", "--platform", "cpu",
+            "--entities", str(args.entities), "--ticks", str(args.ticks),
+            "--sessions", str(sessions), "--seed", str(args.seed),
+            "--interest-radius", str(radius),
+        ]
+        if args.no_combat:
+            cmd.append("--no-combat")
+        if serve_batch:
+            cmd.append("--serve-batch")
+        if args.serve_overlap:
+            cmd.append("--serve-overlap")
+        point = {"sessions": sessions, "serve_batch": serve_batch}
+        try:
+            r = subprocess.run(
+                cmd, capture_output=True, text=True,
+                timeout=args.sweep_timeout,
+            )
+        except subprocess.TimeoutExpired:
+            point["error"] = f"timeout after {args.sweep_timeout:.0f}s"
+            return point
+        for ln in reversed((r.stdout or "").strip().splitlines()):
+            if ln.startswith("{"):
+                try:
+                    p = json.loads(ln)
+                except json.JSONDecodeError:
+                    break
+                if p.get("error"):
+                    point["error"] = p["error"]
+                point["value"] = p.get("value")
+                point["detail"] = p.get("detail")
+                return point
+        point["error"] = f"rc={r.returncode}"
+        point["tail"] = (r.stderr or "").strip().splitlines()[-3:]
+        return point
+
+    points = []
+    for s in counts:
+        if args.sweep_ab:
+            points.append(one(s, False))
+        points.append(one(s, True))
+    head = next(
+        (p for p in points
+         if p.get("serve_batch") and p.get("value") and not p.get("error")),
+        None,
+    )
+    return {
+        "metric": "served_session_sweep",
+        "value": head["value"] if head else 0.0,
+        "unit": "entity-ticks/s",
+        "vs_baseline": round(
+            (head["value"] / NORTH_STAR_RATE) if head else 0.0, 4
+        ),
+        "detail": {
+            "entities": args.entities,
+            "ticks": args.ticks,
+            "seed": args.seed,
+            "interest_radius": radius,
+            "sweep_sessions": counts,
+            "sweep_ab": bool(args.sweep_ab),
+            "baseline_artifact": "r05_served_100k_2000s_cpu.json",
+            "baseline_frame_ms_p99": 726.402,
+            "points": points,
+        },
+    }
+
+
 def _run_ladder(probe_note, serve_args) -> None:
     """Driver-default path: try the flagship 1M config, halving on failure
     (round-2: a TPU worker crash at 1M burned the round's artifact).  Each
@@ -821,6 +906,34 @@ def main() -> None:
              "streams (quantized) instead of group-wide broadcast",
     )
     ap.add_argument(
+        "--serve-batch", action="store_true",
+        help="served mode: the NF_SERVE_BATCH engine (vmap-over-sessions "
+             "interest deltas + batched host assembly) instead of the "
+             "legacy per-session loops",
+    )
+    ap.add_argument(
+        "--serve-overlap", action="store_true",
+        help="served mode: double-buffered snapshots — frame N's serve "
+             "overlaps frame N+1's device tick (implies --serve-batch; "
+             "bounded <=1-tick staleness)",
+    )
+    ap.add_argument(
+        "--sweep-sessions", default=None, metavar="N,N,...",
+        help="served mode: run one measurement per session count "
+             "(e.g. 2000,20000,100000), each in a subprocess, and emit "
+             "one combined payload with per-point detail.pipeline "
+             "waterfalls",
+    )
+    ap.add_argument(
+        "--sweep-ab", action="store_true",
+        help="with --sweep-sessions: also run the legacy engine at "
+             "every count (before/after waterfall pairs)",
+    )
+    ap.add_argument(
+        "--sweep-timeout", type=float, default=900.0,
+        help="per-point subprocess timeout for --sweep-sessions",
+    )
+    ap.add_argument(
         "--lat-k", type=int, default=0,
         help="ticks per fused window in the device-honest latency "
              "sampler (per-tick RTT pollution = one dispatch / lat-k); "
@@ -851,6 +964,16 @@ def main() -> None:
     )
     args = ap.parse_args()
     pinned = args.entities is not None or args.ticks is not None
+
+    if args.served and args.sweep_sessions:
+        # the sweep parent never touches jax — every point is a CPU
+        # subprocess, so no platform probe / tuning applies here
+        if args.entities is None:
+            args.entities = 100_000
+        if args.ticks is None:
+            args.ticks = 8
+        _emit(_run_session_sweep(args))
+        return
 
     probe_note = None
     if args.sharded:

@@ -229,6 +229,11 @@ class _ClassHost:
         # guid identities for thousands of rows with one gather
         self.guid_head = np.zeros(capacity, np.int64)
         self.guid_data = np.zeros(capacity, np.int64)
+        # allocation generation, +1 per free: guids never recycle (pure
+        # counter), so within one generation row ⟺ guid.  The batched
+        # serve edge (ops/serving.py) uploads this i32 vector instead of
+        # comparing int64 guid pairs on device
+        self.row_gen = np.zeros(capacity, np.int32)
         self.live_count = 0
 
     def alloc(self) -> int:
@@ -261,6 +266,7 @@ class _ClassHost:
         self.alloc_mask[row] = False
         self.guid_head[row] = 0
         self.guid_data[row] = 0
+        self.row_gen[row] += 1  # row recycled ⇒ any future guid differs
         self.live_count -= 1
 
 

@@ -517,6 +517,19 @@ class Kernel(Module):
 
     def tick(self) -> TickOutputs:
         """Advance the world one frame and fan out host-visible effects."""
+        return self.tick_finish(self.tick_begin())
+
+    def tick_begin(self) -> Dict[str, object]:
+        """Dispatch one frame's step and return the raw output handle
+        WITHOUT fetching anything.  The device runs asynchronously until
+        `tick_finish(raw)` syncs on the summary — the seam the serving
+        edge's overlap mode uses to assemble/encode frame N's packets on
+        the host while the device computes frame N+1.
+
+        Donation hazard: `_jit_step` donates the carried state, so the
+        PRE-dispatch buffers are invalid the moment this returns.  Any
+        reader of pre-tick state (snapshot fetches, serve kernels) must
+        run before tick_begin."""
         self.compile()
         self._ensure_aux()
         with self._span("kernel.dispatch"):
@@ -524,6 +537,11 @@ class Kernel(Module):
             if self.stage_timing:
                 jax.block_until_ready((self.state, raw))
         self.tick_count += 1
+        return raw
+
+    def tick_finish(self, raw: Dict[str, object]) -> TickOutputs:
+        """Fetch a dispatched frame's outputs and fan out host-visible
+        effects (events, diffs, death reconciliation, counters)."""
         out = TickOutputs(
             fired=raw["fired"],
             diff=raw["diff"],

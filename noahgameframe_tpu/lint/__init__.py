@@ -34,6 +34,7 @@ from .rules_contracts import (
     PumpSurfaceRule,
 )
 from .rules_determinism import UnseededRngRule, WallClockRule
+from .rules_serving import ServeLoopRule
 from .rules_trace import RecompileHazardRule, TraceSafetyRule
 from .rules_wire import DispatchHandlerRule, StructCodecRule
 
@@ -49,6 +50,7 @@ ALL_RULES = (
     RecompileHazardRule,
     StructCodecRule,
     DispatchHandlerRule,
+    ServeLoopRule,
 )
 
 RULES_BY_NAME = {cls.name: cls for cls in ALL_RULES}

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import json as _json
+import os
 import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -143,6 +144,13 @@ def guid_ident(g: Guid) -> Ident:
     return Ident(svrid=g.head, index=g.data)
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 @dataclasses.dataclass
 class Session:
     ident: Ident
@@ -168,6 +176,8 @@ class GameRole(ServerRole):
         cross_server_sync: bool = True,
         batch_sync_min: int = 256,
         interest_radius: Optional[float] = None,
+        serve_batch: Optional[bool] = None,
+        serve_overlap: Optional[bool] = None,
         checkpoint_dir=None,
         checkpoint_seconds: float = 30.0,
         resume: bool = False,
@@ -202,6 +212,43 @@ class GameRole(ServerRole):
         # (visible sets can change without any Position diff)
         self._interest_dirty: set = set()
         self._last_obs_sig: Optional[tuple] = None
+        # --- batched serving edge (ISSUE 13) -------------------------
+        # NF_SERVE_BATCH=1 swaps the per-session Python serve loops for
+        # one vmap-over-sessions device kernel (ops/serving.py) plus
+        # SoA host assembly (net/serving.py).  NF_SERVE_OVERLAP=1
+        # (implies batch) additionally double-buffers the serve
+        # snapshot: the interest Position lane is computed against the
+        # PRE-tick state and its assembly/encode/send overlaps the
+        # device tick — clients see those diffs exactly one tick later
+        # (bounded staleness <= 1 tick, journaled in the run meta).
+        def _env_flag(name: str, explicit: Optional[bool]) -> bool:
+            if explicit is not None:
+                return bool(explicit)
+            return os.environ.get(name, "0") == "1"
+
+        self.serve_overlap = (
+            _env_flag("NF_SERVE_OVERLAP", serve_overlap)
+            and interest_radius is not None
+        )
+        self.serve_batch = self.serve_overlap or (
+            _env_flag("NF_SERVE_BATCH", serve_batch)
+            and interest_radius is not None
+        )
+        from ..serving import SessionTable
+
+        self._session_table = SessionTable()
+        self._serve_jit: Dict[tuple, object] = {}
+        # per-class device position-version state (role-held, NOT kernel
+        # aux: kernel.invalidate() drops aux on recompile, but versions
+        # must survive recompiles or every client would get a full
+        # resend) — cname -> (qver [C] i32, prev_q [C,3] i32)
+        self._serve_qver: Dict[str, tuple] = {}
+        # host-side guid mirrors as of the LAST serve run: gone lists
+        # name entities whose rows may already be freed (guid zeroed in
+        # the live arrays), so the wire payload gathers from these
+        self._serve_prev_guids: Dict[str, tuple] = {}
+        # overlap mode: deferred Position-lane inputs from last frame
+        self._serve_pending: Dict[str, object] = {}
         self.game_world = world if world is not None else GameWorld(
             WorldConfig(combat=False, movement=False, regen=True)
         ).start()
@@ -376,6 +423,13 @@ class GameRole(ServerRole):
                     "resumed": bool(resume),
                     "verlet_skin": float(skin_from_env()),
                     "guid_seed": int(guid_seed),
+                    # serving-edge staleness contract: with overlap on,
+                    # the interest Position lane serves the PRE-tick
+                    # snapshot (clients run <= 1 tick behind); replay
+                    # must honor the same engine to stay digest-clean
+                    "serve_batch": bool(self.serve_batch),
+                    "serve_overlap": bool(self.serve_overlap),
+                    "serve_staleness_ticks": 1 if self.serve_overlap else 0,
                 },
             )
             # tap BOTH dispatch choke points: client/proxy traffic on the
@@ -450,6 +504,27 @@ class GameRole(ServerRole):
         # kernel into honest per-stage device timing; NF_TRACE_SAMPLE=N
         # traces 1-in-N sessions (0 disables).
         self.stage_clock = StageClock(self.telemetry.registry)
+        # serving-edge metrics (docs/OBSERVABILITY.md): dispatch count,
+        # sessions covered per dispatch, emitted packets, deferred-lane
+        # frames (overlap) — the assemble stage histogram itself comes
+        # from StageClock ("nf_stage_assemble_seconds")
+        sreg = self.telemetry.registry
+        self._serve_dispatches = sreg.counter(
+            "nf_serve_dispatches_total",
+            "batched serve-kernel dispatches (per class, per chunk)",
+        )
+        self._serve_packets = sreg.counter(
+            "nf_serve_packets_total",
+            "per-session packets emitted by the batched serve edge",
+        )
+        self._serve_deferred = sreg.counter(
+            "nf_serve_deferred_frames_total",
+            "frames whose interest lane was served one tick late (overlap)",
+        )
+        self._serve_sessions_hist = sreg.histogram(
+            "nf_serve_sessions",
+            "sessions covered by one batched serve dispatch",
+        )
         self._stage_timing = stage_timing_enabled()
         self.kernel.stage_timing = self._stage_timing
         self._trace_sample = trace_sample_n()
@@ -898,6 +973,16 @@ class GameRole(ServerRole):
         if sess is not None:
             self._despawn(sess)
 
+    def reset_view(self, sess: Session) -> dict:
+        """Forget everything this session's client mirrors: fresh legacy
+        seen-dict AND a wiped device seen-state row (batched engine).
+        The single chokepoint for every view reset — despawn, switch-out,
+        out-of-band destroy, and the lazy first-serve init all route
+        here so the two engines can never drift on reset semantics."""
+        seen = sess._interest_seen = {}
+        self._session_table.reset_view(_ident_key(sess.ident))
+        return seen
+
     def _despawn(self, sess: Session) -> None:
         if sess.guid is None:
             return
@@ -907,7 +992,7 @@ class GameRole(ServerRole):
         # the interest seen-state belongs to the AVATAR's view: a fresh
         # client (crash + reconnect) starts with an empty mirror, so a
         # stale seen-state would suppress every stationary entity forever
-        sess._interest_seen = {}
+        self.reset_view(sess)
         self._guid_session.pop(guid, None)
         # PVP hygiene: a queued ticket would ghost-match a gone player,
         # and an unconsumed room entry would leak forever
@@ -1627,7 +1712,7 @@ class GameRole(ServerRole):
             sess = self.sessions.pop(key, None)
             if sess is not None:
                 sess.guid = None
-                sess._interest_seen = {}
+                self.reset_view(sess)
         if guid in self.kernel.store.guid_map:
             self.kernel.destroy_object(guid)
 
@@ -1723,10 +1808,20 @@ class GameRole(ServerRole):
         # one stage-clock frame spans tick + flush of this pump pass; a
         # flush can also fire alone (host writes between ticks)
         framed = tick_due or bool(self._changed or self._rec_changed
-                                  or self._interest_dirty)
+                                  or self._interest_dirty
+                                  or self._serve_pending)
         if framed:
             sc.frame_begin(self.kernel.tick_count)
         flushed = False
+        # overlap mode: interest lanes deferred by the last flush, to be
+        # served against THIS frame's pre-tick snapshot (sync_classes
+        # order, same as a flush)
+        pend_classes: List[str] = []
+        if self._serve_pending:
+            pend_classes = [
+                cn for cn in self.sync_classes if cn in self._serve_pending
+            ]
+            self._serve_pending.clear()
         if tick_due:
             self._last_tick = now
             with self.telemetry.tracer.span("game.tick"), sc.stage("tick"):
@@ -1735,7 +1830,26 @@ class GameRole(ServerRole):
                     if m is not self.kernel:
                         m.execute()
                 self.kernel.execute()
-                self.kernel.tick()
+                if pend_classes:
+                    # double-buffered serve: fetch the deferred lanes'
+                    # deltas from the pre-tick state (the donated buffers
+                    # die at dispatch), start the device tick, and do all
+                    # host assembly/encode/send while the device runs
+                    with sc.stage("interest"):
+                        pend = [
+                            d for d in (
+                                self._serve_pos_collect(cn)
+                                for cn in pend_classes
+                            ) if d is not None
+                        ]
+                    raw = self.kernel.tick_begin()
+                    self._serve_deferred.inc()
+                    with sc.stage("assemble"):
+                        for d in pend:
+                            self._serve_pos_emit(d)
+                    self.kernel.tick_finish(raw)
+                else:
+                    self.kernel.tick()
                 pm.frame += 1
                 self._tick_hist.observe(_time.perf_counter() - t0)
             if self.journal is not None:
@@ -1751,6 +1865,14 @@ class GameRole(ServerRole):
                 # the flusher thread (the smoke asserts the tick never
                 # blocks even with injected store latency)
                 self._persist_harvest()
+        elif pend_classes:
+            # ticks stopped (idle pump): drain the deferred lanes
+            # synchronously so staleness stays bounded by pump latency
+            with self.telemetry.tracer.span("game.flush"):
+                with sc.stage("interest"):
+                    for cn in pend_classes:
+                        self._send_interest_pos_batched(cn)
+                flushed = True
         # _interest_dirty alone must also trigger a flush: a destroy with
         # no property diff still changes visible sets (gone lists)
         if self._changed or self._rec_changed or self._interest_dirty:
@@ -2138,6 +2260,9 @@ class GameRole(ServerRole):
                 jnp.asarray(obs_rows), jnp.asarray(obs_valid),
             )
         vrows, vok = np.asarray(vrows), np.asarray(vok)
+        # nf-lint: disable=serve-loop -- per-entity property lane shared
+        # by both engines; diffs here are < batch_sync_min rows, so the
+        # loop is small-N (batching it is ROADMAP debt, not serve-path)
         for i, sess in enumerate(obs):
             g = sess.guid
             if g is None:
@@ -2166,6 +2291,23 @@ class GameRole(ServerRole):
         with sc.stage("harvest"):
             changed, self._changed = self._changed, {}
             player_idx = self._build_player_index()
+            obs_moved = False
+            if self.interest_radius is not None:
+                # observer-set gate: any session join/leave/respawn must
+                # wake the interest lane even with zero Position diffs.
+                # Lives in HARVEST (it walks the session dict — shared
+                # bookkeeping, not serve work; the batched engine's
+                # interest stage is loop-free, nf-lint serve-loop rule)
+                obs_sig = tuple(sorted(
+                    (key, s.guid)
+                    for key, s in self.sessions.items()
+                    if s.guid is not None
+                    and s.guid in self.kernel.store.guid_map
+                ))
+                obs_moved = obs_sig != self._last_obs_sig
+                self._last_obs_sig = obs_sig
+                if self.serve_batch:
+                    self._serve_refresh_table()
         # interest lane: Position diffs of synced classes leave as
         # per-session interest-filtered streams when a radius is set.
         # The pipeline only runs when something that can change a visible
@@ -2175,14 +2317,6 @@ class GameRole(ServerRole):
         self._obs_cache = None  # one _observer_arrays() per flush
         if self.interest_radius is not None:
             with sc.stage("interest"):
-                obs_sig = tuple(sorted(
-                    (key, s.guid)
-                    for key, s in self.sessions.items()
-                    if s.guid is not None
-                    and s.guid in self.kernel.store.guid_map
-                ))
-                obs_moved = obs_sig != self._last_obs_sig
-                self._last_obs_sig = obs_sig
 
                 def zone_changed(cn: str) -> bool:
                     # visible sets mask on scene+group too — a swap with
@@ -2206,7 +2340,15 @@ class GameRole(ServerRole):
                             or zone_changed(cname)
                             or cname in self._interest_dirty):
                         self._interest_dirty.discard(cname)
-                        self._send_interest_pos(cname)
+                        if self.serve_overlap:
+                            # double-buffered: serve this class's lane
+                            # against the PRE-tick snapshot of the next
+                            # frame, overlapping assembly with its tick
+                            self._serve_pending[cname] = True
+                        elif self.serve_batch:
+                            self._send_interest_pos_batched(cname)
+                        else:
+                            self._send_interest_pos(cname)
         with sc.stage("encode"):
             # columnar fast lane: large public scalar/vector diffs leave
             # as packed-array batches (100k movers = a handful of
@@ -2469,6 +2611,9 @@ class GameRole(ServerRole):
         from ...core.datatypes import next_pow2
 
         k = self.kernel
+        # nf-lint: disable=serve-loop -- legacy engine's observer
+        # collector (the parity oracle for NF_SERVE_BATCH); the batched
+        # path reads the SessionTable columns instead
         obs = [
             s for s in self.sessions.values()
             if s.guid is not None and s.guid in k.store.guid_map
@@ -2534,12 +2679,15 @@ class GameRole(ServerRole):
         rows_np, ok_np = np.asarray(rows), np.asarray(ok)
         host = k.store._hosts[cname]
         scale = float(self.game_world.config.extent) / QMAX
+        # nf-lint: disable=serve-loop -- the legacy per-session engine
+        # itself (NF_SERVE_BATCH=0): kept as the bit-identity oracle for
+        # tests/test_serve_batch.py, never the production hot path
         for i, sess in enumerate(obs):
             vis = rows_np[i][ok_np[i]]
             vis = vis[host.alloc_mask[vis]]  # drop just-died rows
             seen = getattr(sess, "_interest_seen", None)
             if seen is None:
-                seen = sess._interest_seen = {}
+                seen = self.reset_view(sess)
             vis = np.sort(vis)
             heads = host.guid_head[vis]
             datas = host.guid_data[vis]
@@ -2590,6 +2738,475 @@ class GameRole(ServerRole):
                 gone_index=gone_d.tobytes(),
             )
             self._send_to_session(sess, MsgID.ACK_INTEREST_POS, msg)
+
+    # ------------------------------------------------ batched serve edge
+    # ISSUE 13: the NF_SERVE_BATCH engine.  Same wire bytes as the legacy
+    # loops above (tests/test_serve_batch.py proves bit-identity), but
+    # the per-session set algebra runs as ONE vmap-over-sessions device
+    # dispatch (ops/serving.py) against the SessionTable's seen-state,
+    # and the host's only per-session work is slicing precomputed byte
+    # buffers into packets (net/serving.py).
+
+    def _serve_geometry(self, cname: str):
+        """(cell, width, bucket, m): grid geometry shared with the legacy
+        jits — identical candidate sets are the parity precondition.  `m`
+        is the seen-table width: 9*bucket covers every candidate slot
+        exactly; NF_SERVE_SLOTS can cap it (memory at huge session
+        counts) at the cost of dropping the farthest-slot candidates of
+        overfull views for a frame."""
+        geom = getattr(self, "_serve_geom", None)
+        if geom is None:
+            geom = self._serve_geom = {}
+        g = geom.get(cname)
+        if g is not None:
+            return g
+        from ...ops.stencil import auto_bucket
+
+        extent = float(self.game_world.config.extent)
+        radius = float(self.interest_radius)
+        skin = float(self._interest_skin)
+        cell = radius + skin if skin > 0.0 else radius
+        width = max(1, int(np.ceil(extent / cell)))
+        bucket = auto_bucket(self.kernel.store.capacity(cname), width)
+        m = 9 * bucket
+        cap_m = _env_int("NF_SERVE_SLOTS", 0)
+        if cap_m > 0:
+            m = min(m, cap_m)
+        g = (cell, width, bucket, m)
+        geom[cname] = g
+        return g
+
+    def _serve_refresh_table(self) -> None:
+        """Harvest-stage SessionTable sync: one slot per session with a
+        live avatar.  Slots of departed sessions free here (robust to
+        every removal path), stale seen-state wipes on realloc."""
+        st = self._session_table
+        for key in list(st.slot_of):
+            if key not in self.sessions:
+                st.release(key)
+        k = self.kernel
+        for key, s in self.sessions.items():
+            if s.guid is not None and s.guid in k.store.guid_map:
+                st.ensure(key, s.conn_id, k.store.row_of(s.guid)[1])
+            else:
+                st.invalidate(key)
+
+    def _serve_prepare(self, cname: str):
+        """Per-class 'prepare' jit: quantize + position-version bump +
+        cell-table build, ONCE per frame regardless of session chunking
+        (per-chunk bumping would multi-count a single move)."""
+        key = ("sprep", cname)
+        fn = self._serve_jit.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from ...ops.interest import _interest_feats, quantize
+        from ...ops.serving import bump_qver
+        from ...ops.stencil import build_cell_table
+        from ...ops.verlet import refresh, sub_table
+
+        spec = self.kernel.store.spec(cname)
+        pos_col = spec.slots["Position"].col
+        sc_col, gr_col = spec.slots["SceneID"].col, spec.slots["GroupID"].col
+        extent = float(self.game_world.config.extent)
+        skin = float(self._interest_skin)
+        cell, width, bucket, _m = self._serve_geometry(cname)
+
+        if skin > 0.0:
+            def prep(evec, ei32, alive, qver, prev_q, cache):
+                pos3 = evec[:, pos_col]
+                q, in_extent = quantize(pos3, alive, extent)
+                qver2, prev2 = bump_qver(q, prev_q, qver)
+                cache, _rebuilt = refresh(
+                    cache, pos3, alive, cell, width, bucket, skin
+                )
+                feats = _interest_feats(
+                    pos3,
+                    ei32[:, sc_col].astype(jnp.float32),
+                    ei32[:, gr_col].astype(jnp.float32),
+                )
+                table = sub_table(
+                    cache, in_extent & alive, feats, width * width,
+                    cell, width, bucket,
+                )
+                return q, qver2, prev2, table.payload, cache
+        else:
+            def prep(evec, ei32, alive, qver, prev_q):
+                pos3 = evec[:, pos_col]
+                q, in_extent = quantize(pos3, alive, extent)
+                qver2, prev2 = bump_qver(q, prev_q, qver)
+                feats = _interest_feats(
+                    pos3,
+                    ei32[:, sc_col].astype(jnp.float32),
+                    ei32[:, gr_col].astype(jnp.float32),
+                )
+                table = build_cell_table(
+                    pos3, in_extent, feats, cell, width, bucket
+                )
+                return q, qver2, prev2, table.payload
+
+        fn = jax.jit(prep)
+        self._serve_jit[key] = fn
+        return fn
+
+    def _serve_scan(self, cname: str, s_chunk: int):
+        """Per-(class, chunk) 'scan' jit: 3x3 candidate read + the full
+        delta set algebra for a contiguous block of session slots.  Only
+        the payload array crosses the prepare/scan seam — the grid
+        geometry is static in both closures."""
+        key = ("sscan", cname, s_chunk)
+        fn = self._serve_jit.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from ...ops.interest import _scan_observers
+        from ...ops.serving import SeenTable, interest_delta, slot_compact
+        from ...ops.stencil import CellTable
+
+        pspec = self.kernel.store.spec("Player")
+        p_pos = pspec.slots["Position"].col
+        p_sc, p_gr = pspec.slots["SceneID"].col, pspec.slots["GroupID"].col
+        radius = float(self.interest_radius)
+        cell, width, bucket, m = self._serve_geometry(cname)
+        k9 = 9 * bucket
+
+        def scan(payload, pvec, pi32, obs_rows, valid, alloc_ok, gen,
+                 qver, seen_rows, seen_gen, seen_qver):
+            table = CellTable(
+                payload, jnp.zeros((1,), jnp.int32),
+                jnp.zeros((), jnp.int32), width, cell, bucket,
+            )
+            res = _scan_observers(
+                table,
+                pvec[obs_rows, p_pos][:, :2],
+                pi32[obs_rows, p_sc].astype(jnp.float32),
+                pi32[obs_rows, p_gr].astype(jnp.float32),
+                radius, cell,
+            )
+            # device-side alloc filter: the legacy loop's just-died-row
+            # drop (host.alloc_mask), applied before the delta algebra
+            ok = res.ok & valid[:, None] & alloc_ok[res.rows]
+            rows = res.rows
+            if m < k9:  # NF_SERVE_SLOTS cap: keep slot-order prefix
+                rows, counts = slot_compact(rows, ok)
+                rows = rows[:, :m]
+                ok = jnp.arange(m, dtype=jnp.int32)[None, :] < counts[:, None]
+            return interest_delta(
+                rows, ok, gen, qver,
+                SeenTable(seen_rows, seen_gen, seen_qver),
+            )
+
+        fn = jax.jit(scan)
+        self._serve_jit[key] = fn
+        return fn
+
+    def _serve_pos_collect(self, cname: str):
+        """Device half of the batched Position lane: dispatch prepare +
+        chunked scans and FETCH the dense delta buffers.  Returns the
+        host-assembly payload, or None when there are no observers.
+        Must run before tick dispatch (donation invalidates the serve
+        kernel's input buffers); the returned dict needs no device."""
+        import jax
+        import jax.numpy as jnp
+
+        k = self.kernel
+        st = self._session_table
+        if st.capacity == 0 or not st.valid.any():
+            return None
+        host = k.store._hosts[cname]
+        cs = k.state.classes[cname]
+        pcs = k.state.classes["Player"]
+        _cell, _width, _bucket, m = self._serve_geometry(cname)
+
+        cap = k.store.capacity(cname)
+        qp = self._serve_qver.get(cname)
+        if qp is None or qp[0].shape[0] != cap:
+            # prev_q = -1: every row's first observed quantum counts as
+            # a change, so a fresh engine never suppresses a first send
+            qp = (jnp.zeros(cap, jnp.int32),
+                  jnp.full((cap, 3), -1, jnp.int32))
+        qver, prev_q = qp
+
+        prep = self._serve_prepare(cname)
+        if self._interest_skin > 0.0:
+            ckey, cache = self._interest_cache_for(cname)
+            q, qver, prev_q, payload, cache = prep(
+                cs.vec, cs.i32, cs.alive, qver, prev_q, cache
+            )
+            self._interest_cache_store(ckey, cache)
+        else:
+            q, qver, prev_q, payload = prep(
+                cs.vec, cs.i32, cs.alive, qver, prev_q
+            )
+        self._serve_qver[cname] = (qver, prev_q)
+
+        gen = jnp.asarray(host.row_gen)
+        alloc_ok = jnp.asarray(host.alloc_mask)
+        obs_rows = jnp.asarray(st.avatar_row)
+        valid = jnp.asarray(st.valid)
+        seen = st.seen_for(cname, m)
+
+        s_total = st.capacity
+        chunk = _env_int("NF_SERVE_CHUNK", 0)
+        if chunk <= 0 or chunk >= s_total:
+            chunk = s_total
+        parts = []
+        for c0 in range(0, s_total, chunk):
+            c1 = c0 + chunk
+            fn = self._serve_scan(cname, chunk)
+            delta = fn(
+                payload, pcs.vec, pcs.i32,
+                obs_rows[c0:c1], valid[c0:c1], alloc_ok, gen, qver,
+                seen.rows[c0:c1], seen.gen[c0:c1], seen.qver[c0:c1],
+            )
+            self._serve_dispatches.inc()
+            parts.append(jax.device_get(
+                (delta.vis, delta.send, delta.gone, delta.gone_rows)
+            ))
+            seen = type(seen)(
+                rows=seen.rows.at[c0:c1].set(delta.seen.rows),
+                gen=seen.gen.at[c0:c1].set(delta.seen.gen),
+                qver=seen.qver.at[c0:c1].set(delta.seen.qver),
+            )
+        st.store_seen(cname, seen)
+        self._serve_sessions_hist.observe(int(st.valid.sum()))
+
+        # gone lists carry guids AS LAST SERVED — freed rows have their
+        # live guid zeroed, so gather from the previous run's mirrors
+        prev_h, prev_d = self._serve_prev_guids.get(
+            cname, (host.guid_head, host.guid_data)
+        )
+        self._serve_prev_guids[cname] = (
+            host.guid_head.copy(), host.guid_data.copy()
+        )
+        cat = (lambda i: np.concatenate([p[i] for p in parts])
+               if len(parts) > 1 else parts[0][i])
+        return {
+            "cname": cname,
+            "q": np.asarray(q).astype(np.uint16),
+            "vis": cat(0), "send": cat(1),
+            "gone": cat(2), "gone_rows": cat(3),
+            "prev_h": prev_h, "prev_d": prev_d,
+        }
+
+    def _serve_pos_emit(self, data) -> None:
+        """Host half: batched frame assembly.  Flatten the [S, M] masks
+        (row-major = session-major, per-session ascending because vis is
+        sorted), gather ONCE from the host guid mirrors, materialize ONE
+        payload per wire field, and slice per-session packets at cumsum
+        byte offsets — zero per-session device syncs or numpy passes."""
+        from ...ops.interest import QMAX
+        from ..serving import segments
+        from ..wire import InterestPosSync
+
+        k = self.kernel
+        st = self._session_table
+        host = k.store._hosts[data["cname"]]
+        q_np, vis, send = data["q"], data["vis"], data["send"]
+        gone, gone_rows = data["gone"], data["gone_rows"]
+
+        send_counts = send.sum(axis=1)
+        gone_counts = gone.sum(axis=1)
+        flat_rows = vis[send]
+        heads_b = host.guid_head[flat_rows].tobytes()
+        datas_b = host.guid_data[flat_rows].tobytes()
+        qpos_b = np.ascontiguousarray(q_np[flat_rows]).tobytes()
+        o8, _ = segments(send_counts, 8, heads_b)
+        o6, _ = segments(send_counts, 6, qpos_b)
+        flat_gone = gone_rows[gone]
+        gh_b = data["prev_h"][flat_gone].tobytes()
+        gd_b = data["prev_d"][flat_gone].tobytes()
+        g8, _ = segments(gone_counts, 8, gh_b)
+
+        scale = float(self.game_world.config.extent) / QMAX
+        sent = 0
+        for key, sess in self.sessions.items():
+            slot = st.slot_of.get(key)
+            if slot is None or not st.valid[slot]:
+                continue
+            ns, ng = int(send_counts[slot]), int(gone_counts[slot])
+            if ns == 0 and ng == 0:
+                continue
+            msg = InterestPosSync(
+                scale=scale,
+                count=ns,
+                svrid=heads_b[o8[slot]:o8[slot + 1]],
+                index=datas_b[o8[slot]:o8[slot + 1]],
+                qpos=qpos_b[o6[slot]:o6[slot + 1]],
+                gone_svrid=gh_b[g8[slot]:g8[slot + 1]],
+                gone_index=gd_b[g8[slot]:g8[slot + 1]],
+            )
+            self._send_to_session(sess, MsgID.ACK_INTEREST_POS, msg)
+            sent += 1
+        self._serve_packets.inc(sent)
+
+    def _send_interest_pos_batched(self, cname: str) -> None:
+        """Synchronous batched Position lane (NF_SERVE_BATCH without
+        overlap): collect in the interest stage, assemble+send nested
+        under 'assemble' so the waterfall attributes the host slicing."""
+        data = self._serve_pos_collect(cname)
+        if data is None:
+            return
+        with self.stage_clock.stage("assemble"):
+            self._serve_pos_emit(data)
+
+    def _serve_query(self, cname: str, s_pad: int):
+        """Batched interest-scoped BatchPropertySync query jit: legacy
+        `_interest_query` + device alloc filter + stable slot-order
+        compaction (the lane's wire order is candidate slot order)."""
+        key = ("bscan", cname, s_pad)
+        fn = self._serve_jit.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from ...ops.interest import (
+            visible_candidates,
+            visible_candidates_cached,
+        )
+        from ...ops.serving import slot_compact
+
+        k = self.kernel
+        spec = k.store.spec(cname)
+        pspec = k.store.spec("Player")
+        pos_col = spec.slots["Position"].col
+        sc_col, gr_col = spec.slots["SceneID"].col, spec.slots["GroupID"].col
+        p_pos = pspec.slots["Position"].col
+        p_sc, p_gr = pspec.slots["SceneID"].col, pspec.slots["GroupID"].col
+        radius = float(self.interest_radius)
+        skin = float(self._interest_skin)
+        cell, width, bucket, _m = self._serve_geometry(cname)
+
+        if skin > 0.0:
+            def query(evec, ei32, changed, alive, pvec, pi32, obs_rows,
+                      valid, alloc_ok, cache):
+                res, cache, _rebuilt = visible_candidates_cached(
+                    cache, evec[:, pos_col], changed, alive,
+                    ei32[:, sc_col].astype(jnp.float32),
+                    ei32[:, gr_col].astype(jnp.float32),
+                    pvec[obs_rows, p_pos][:, :2],
+                    pi32[obs_rows, p_sc].astype(jnp.float32),
+                    pi32[obs_rows, p_gr].astype(jnp.float32),
+                    radius=radius, cell_size=cell, width=width,
+                    bucket=bucket, skin=skin,
+                )
+                ok = res.ok & valid[:, None] & alloc_ok[res.rows]
+                rows, counts = slot_compact(res.rows, ok)
+                return rows, counts, cache
+        else:
+            def query(evec, ei32, changed, pvec, pi32, obs_rows, valid,
+                      alloc_ok):
+                res = visible_candidates(
+                    evec[:, pos_col], changed,
+                    ei32[:, sc_col].astype(jnp.float32),
+                    ei32[:, gr_col].astype(jnp.float32),
+                    pvec[obs_rows, p_pos][:, :2],
+                    pi32[obs_rows, p_sc].astype(jnp.float32),
+                    pi32[obs_rows, p_gr].astype(jnp.float32),
+                    radius=radius, cell_size=cell, width=width,
+                    bucket=bucket,
+                )
+                ok = res.ok & valid[:, None] & alloc_ok[res.rows]
+                rows, counts = slot_compact(res.rows, ok)
+                return rows, counts
+
+        fn = jax.jit(query)
+        self._serve_jit[key] = fn
+        return fn
+
+    def _send_batch_property_interest_batched(
+        self, cname: str, pname: str, rows: np.ndarray
+    ) -> None:
+        """Batched interest-scoped columnar sync: one device query for
+        all sessions, one value gather, per-session byte slices."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..serving import segments
+        from ..wire import BatchPropertySync
+
+        k = self.kernel
+        st = self._session_table
+        host = k.store._hosts[cname]
+        spec = k.store.spec(cname)
+        slot = spec.slot(pname)
+        rows = rows[host.alloc_mask[rows]]
+        if rows.size == 0 or st.capacity == 0 or not st.valid.any():
+            return
+        cap = k.store.capacity(cname)
+        changed = np.zeros(cap, bool)
+        changed[rows] = True
+        cs = k.state.classes[cname]
+        pcs = k.state.classes["Player"]
+        fn = self._serve_query(cname, st.capacity)
+        obs_rows = jnp.asarray(st.avatar_row)
+        valid = jnp.asarray(st.valid)
+        alloc_ok = jnp.asarray(host.alloc_mask)
+        if self._interest_skin > 0.0:
+            ckey, cache = self._interest_cache_for(cname)
+            vrows, counts, cache = fn(
+                cs.vec, cs.i32, jnp.asarray(changed), cs.alive,
+                pcs.vec, pcs.i32, obs_rows, valid, alloc_ok, cache,
+            )
+            self._interest_cache_store(ckey, cache)
+        else:
+            vrows, counts = fn(
+                cs.vec, cs.i32, jnp.asarray(changed),
+                pcs.vec, pcs.i32, obs_rows, valid, alloc_ok,
+            )
+        self._serve_dispatches.inc()
+        vrows, counts = jax.device_get((vrows, counts))
+
+        if slot.bank == Bank.VEC:
+            vals = gather_rows(cs.vec, rows, cols=slot.col)[:, 0]
+        elif slot.bank == Bank.F32:
+            vals = gather_rows(cs.f32, rows, cols=slot.col)[:, 0]
+        else:
+            vals = gather_rows(cs.i32, rows, cols=slot.col)[:, 0]
+        vals = np.asarray(vals)
+        pos_of = np.full(cap, -1, np.int64)
+        pos_of[rows] = np.arange(rows.size)
+
+        with self.stage_clock.stage("assemble"):
+            mask = np.arange(vrows.shape[1])[None, :] < counts[:, None]
+            flat = vrows[mask]  # session-major, slot order per session
+            heads_b = host.guid_head[flat].tobytes()
+            datas_b = host.guid_data[flat].tobytes()
+            vals_flat = np.ascontiguousarray(vals[pos_of[flat]])
+            item = vals_flat.itemsize * (
+                int(np.prod(vals_flat.shape[1:])) if vals_flat.ndim > 1
+                else 1
+            )
+            data_b = vals_flat.tobytes()
+            o8, _ = segments(counts, 8, heads_b)
+            ov, _ = segments(counts, item, data_b)
+            name_b, cls_b = pname.encode(), cname.encode()
+            ptype = int(slot.prop.type)
+            sent = 0
+            for key, sess in self.sessions.items():
+                si = st.slot_of.get(key)
+                if si is None or not st.valid[si]:
+                    continue
+                n = int(counts[si])
+                if n == 0:
+                    continue
+                msg = BatchPropertySync(
+                    class_name=cls_b,
+                    property_name=name_b,
+                    ptype=ptype,
+                    count=n,
+                    svrid=heads_b[o8[si]:o8[si + 1]],
+                    index=datas_b[o8[si]:o8[si + 1]],
+                    data=data_b[ov[si]:ov[si + 1]],
+                )
+                self._send_to_session(sess, MsgID.ACK_BATCH_PROPERTY, msg)
+                sent += 1
+            self._serve_packets.inc(sent)
 
     def _send_batch_property_interest(self, cname: str, pname: str,
                                       rows: np.ndarray) -> None:
@@ -2644,6 +3261,9 @@ class GameRole(ServerRole):
         pos_of[rows] = np.arange(rows.size)
         name_b, cls_b = pname.encode(), cname.encode()
         ptype = int(slot.prop.type)
+        # nf-lint: disable=serve-loop -- legacy columnar lane
+        # (NF_SERVE_BATCH=0), the parity oracle for the batched
+        # _send_batch_property_interest_batched above
         for i, sess in enumerate(obs):
             vis = vrows[i][vok[i]]
             vis = vis[host.alloc_mask[vis]]
@@ -2668,7 +3288,10 @@ class GameRole(ServerRole):
         of the SoA store — the per-entity proto path stays for strings,
         objects, private props and small diffs."""
         if self.interest_radius is not None and self._interest_ok(cname):
-            self._send_batch_property_interest(cname, pname, rows)
+            if self.serve_batch:
+                self._send_batch_property_interest_batched(cname, pname, rows)
+            else:
+                self._send_batch_property_interest(cname, pname, rows)
             return
         from ...kernel.scene import MAX_GROUPS_PER_SCENE
         from ..wire import BatchPropertySync
@@ -2814,7 +3437,7 @@ class GameRole(ServerRole):
             sess = self.sessions.get(key)
             if sess is not None:
                 sess.guid = None
-                sess._interest_seen = {}
+                self.reset_view(sess)
 
     def _on_npc_event(self, guid: Guid, _cname: str, ev: ObjectEvent) -> None:
         if ev == ObjectEvent.DESTROY and self.sessions:

@@ -32,6 +32,7 @@ from ..wire import (
     ReqSelectServer,
     SwitchNotice,
     ident_key as _ident_key,
+    scan_envelope_targets,
     unwrap,
     wrap,
 )
@@ -382,13 +383,29 @@ class ProxyRole(ServerRole):
         """Deliver the enveloped message to each client in the envelope's
         client list (empty list → the envelope's player_id).  The whole
         MsgBase goes through unchanged, exactly like the reference's
-        `SendMsgWithOutHead(nMsgID, msg, nLen)` — clients always unwrap."""
-        base = MsgBase.decode(body)
-        targets = base.player_client_list or (
-            [base.player_id] if base.player_id is not None else []
-        )
-        for ident in targets:
-            conn_id = self._client_conn.get(_ident_key(ident))
+        `SendMsgWithOutHead(nMsgID, msg, nLen)` — clients always unwrap.
+
+        Pre-assembled frame scatter (ISSUE 13): the game already encoded
+        the envelope once for ALL recipients, so the relay's only job is
+        routing.  `scan_envelope_targets` walks the header fields without
+        materializing msg_data (the frame payload — the big part) or
+        per-client Ident objects; the SAME `body` buffer is handed to
+        every connection.  Per-frame relay cost is O(clients) dict
+        lookups, independent of payload size."""
+        try:
+            keys = scan_envelope_targets(body)
+        except (ValueError, IndexError):
+            # torn envelope: the tolerant object decode decides (and
+            # keeps the drop semantics identical to the legacy path)
+            base = MsgBase.decode(body)
+            keys = [
+                _ident_key(i)
+                for i in (base.player_client_list
+                          or ([base.player_id]
+                              if base.player_id is not None else []))
+            ]
+        for key in keys:
+            conn_id = self._client_conn.get(key)
             if conn_id is not None:
                 self.server.send_raw(conn_id, msg_id, body)
         # per-opcode forward-latency attribution (ISSUE 7 satellite):

@@ -256,6 +256,53 @@ class MsgBase(Message):
     ]
 
 
+def scan_envelope_targets(body: bytes) -> List[Tuple[int, int]]:
+    """Routing keys of a :class:`MsgBase` envelope, without decoding it.
+
+    The proxy's scatter path (`ProxyRole._transpond`) needs only the
+    client list to fan a frame out — not the (possibly megabyte)
+    ``msg_data`` payload and not per-client ``Ident`` objects.  This
+    walks the top-level fields once: ``msg_data`` is skipped in O(1)
+    after its length varint, and each embedded Ident is decoded straight
+    into its :func:`ident_key` tuple.  Returns the
+    ``player_client_list`` keys, falling back to ``player_id`` when the
+    list is absent (the same semantics ``_transpond`` applies to the
+    decoded envelope).  Torn framing raises ``ValueError``/``IndexError``
+    — callers fall back to the tolerant full decode.
+    """
+    targets: List[Tuple[int, int]] = []
+    player: Optional[Tuple[int, int]] = None
+    off, n = 0, len(body)
+    while off < n:
+        key, off = _dec_varint(body, off)
+        tag, wt = key >> 3, key & 7
+        if wt == _WT_LEN and tag in (1, 3):
+            ln, off = _dec_varint(body, off)
+            end = off + ln
+            svrid = index = 0
+            while off < end:
+                ik, off = _dec_varint(body, off)
+                itag, iwt = ik >> 3, ik & 7
+                if iwt == _WT_VARINT and itag in (1, 2):
+                    v, off = _dec_varint(body, off)
+                    if itag == 1:
+                        svrid = _signed64(v)
+                    else:
+                        index = _signed64(v)
+                else:
+                    off = _skip(body, off, iwt)
+            off = end
+            if tag == 3:
+                targets.append((svrid, index))
+            else:
+                player = (svrid, index)
+        else:
+            off = _skip(body, off, wt)
+    if targets:
+        return targets
+    return [player] if player is not None else []
+
+
 class Position(Message):
     FIELDS = [(1, "x", "float", 0.0), (2, "y", "float", 0.0), (3, "z", "float", 0.0)]
 

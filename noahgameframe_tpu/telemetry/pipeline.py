@@ -138,7 +138,7 @@ class StageClock:
     """
 
     STAGES: Tuple[str, ...] = ("tick", "harvest", "interest", "encode",
-                               "send", "other")
+                               "assemble", "send", "other")
 
     def __init__(self, registry=None, window: int = 512):
         self._acc: Dict[str, int] = {}
