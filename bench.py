@@ -277,6 +277,46 @@ def _hist_pcts(hist) -> tuple:
     return tuple(round(hist.percentile(p) * 1e3, 3) for p in (50, 95, 99))
 
 
+def _costbook_detail(book, pipeline_stats=None) -> dict:
+    """Compiled-cost evidence for a BENCH `detail` block: compile wall,
+    recompile count+causes, HBM peak from a fresh census, per-entry
+    cost — and, when the run has a StageClock waterfall, the per-stage
+    achieved-vs-peak roofline fractions (CostBook x StageClock)."""
+    from noahgameframe_tpu.telemetry.costbook import roofline_fold
+
+    hbm = book.hbm_sample()
+    out = {
+        "compile_ms": round(book.compile_s_total * 1e3, 1),
+        "compiles": book.total_compiles,
+        "recompiles": book.total_recompiles,
+        "recompile_causes": {
+            n: dict(e.causes)
+            for n, e in sorted(book.entries.items()) if e.causes
+        },
+        "hbm_peak_bytes": int(hbm.get("peak_bytes", 0)),
+        "hbm_live_bytes": int(hbm.get("live_bytes", 0)),
+        "hbm_source": hbm.get("source"),
+        "entries": {n: {"compiles": e.compiles,
+                        "flops": e.last.get("flops", 0.0),
+                        "bytes_accessed": e.last.get("bytes_accessed", 0.0),
+                        "temp_bytes": e.last.get("temp_bytes", 0)}
+                    for n, e in sorted(book.entries.items())},
+    }
+    if pipeline_stats is not None:
+        rf = roofline_fold(book, pipeline_stats)
+        out["roofline"] = {
+            "platform": rf["platform"],
+            "provisional": rf["provisional"],
+            "stages": {
+                s: {"frac_of_peak_flops": round(v["frac_of_peak_flops"], 6),
+                    "frac_of_peak_bytes": round(v["frac_of_peak_bytes"], 6),
+                    "device_s_per_frame": v["device_s_per_frame"]}
+                for s, v in rf["stages"].items()
+            },
+        }
+    return out
+
+
 def _grid_overflow_max(world) -> int:
     """Rebuild the combat victim cell-table from the final state once
     (outside the timed region) and report entities dropped by bucket
@@ -472,6 +512,10 @@ def run_served(args) -> dict:
             # pipeline stage from the role's StageClock, plus the last
             # frame's exact breakdown and trace-sidecar counters
             "pipeline": role.pipeline_stats(),
+            # compiled-cost evidence + the measured roofline: per-stage
+            # achieved-vs-peak fractions from CostBook x StageClock
+            "costbook": _costbook_detail(role.kernel.costbook,
+                                         role.pipeline_stats()),
         },
     }
 
@@ -529,6 +573,7 @@ def run_sharded(args) -> dict:
             "grid_overflow_max": grid_drop,
             "att_overflow_max": att_drop,
             "binning": binning_mode(),
+            "costbook": _costbook_detail(k.costbook),
         },
     }
 
@@ -675,6 +720,9 @@ def run_bench(args) -> dict:
             # label the count-vs-sort A/B (and decide_tuning) reads
             "binning": binning_mode(),
             **({"verlet": verlet} if verlet else {}),
+            # compiled-cost evidence: compile wall, recompiles+causes,
+            # HBM peak, per-entry FLOPs/bytes (telemetry/costbook.py)
+            "costbook": _costbook_detail(k.costbook),
         },
     }
 

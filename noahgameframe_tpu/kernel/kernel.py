@@ -213,6 +213,15 @@ class Kernel(Module):
         self._diff_flags = diff_flags
         self.store: Optional[EntityStore] = None
         self.state: Optional[WorldState] = None
+        # device cost observatory (telemetry/costbook.py): every jit
+        # entry the kernel owns dispatches through this ledger — compile
+        # time, cost/memory analysis, retrace cause attribution.  Built
+        # here (not by telemetry) so bare-kernel benches record too;
+        # TelemetryModule.attach_kernel adopts it for /costbook+metrics.
+        # Deferred import: telemetry.module imports kernel.module.
+        from ..telemetry.costbook import CostBook
+
+        self.costbook = CostBook()
         # the composed, sorted phase chain the tick runs; the kernel's OWN
         # phases (added via Module.add_phase) stay in self._phases like any
         # other module's so composition can't double-count them
@@ -295,6 +304,7 @@ class Kernel(Module):
         self._jit_step = None
         self._jit_run = None
         self._trace_gen += 1
+        self.costbook.generation_bump("set_phases")
 
     # -- the compiled tick --------------------------------------------------
 
@@ -456,7 +466,10 @@ class Kernel(Module):
 
     def compile(self) -> None:
         if self._jit_step is None:
-            self._jit_step = jax.jit(self._trace_step, donate_argnums=0)
+            self._jit_step = self.costbook.wrap(
+                "kernel.step", self._trace_step,
+                donate_argnums=0, stage="tick",
+            )
 
     def invalidate(self) -> None:
         """Force retrace of the compiled tick.  Call after changing
@@ -469,6 +482,9 @@ class Kernel(Module):
         self._jit_step = None
         self._jit_run = None
         self._trace_gen += 1
+        # sanctioned retrace: anything compiled after this bump is an
+        # expected recompile, not a hazard (soak-gate allowlist seam)
+        self.costbook.generation_bump("invalidate")
         if self._aux_init and self.state is not None and self.state.aux:
             kept = {
                 k: v for k, v in self.state.aux.items()
@@ -599,9 +615,10 @@ class Kernel(Module):
                 st2, _out = self._trace_step(st)
                 return st2
 
-            self._jit_run = jax.jit(
+            self._jit_run = self.costbook.wrap(
+                "kernel.run",
                 lambda st, k: jax.lax.fori_loop(0, k, body, st),
-                donate_argnums=0,
+                donate_argnums=0, stage="tick",
             )
         self.state = self._jit_run(self.state, jnp.int32(key))
         self.tick_count += key

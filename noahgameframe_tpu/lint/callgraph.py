@@ -512,6 +512,26 @@ class _RootCollector(ast.NodeVisitor):
                     self.sites.append(JitSite(
                         self.syms.rel, node.lineno, node, targets,
                         [], set(), set(), "phase"))
+            elif (d is not None and d.split(".")[-1] == "wrap"
+                  and len(node.args) >= 2
+                  and (isinstance(node.args[0], ast.JoinedStr)
+                       or (isinstance(node.args[0], ast.Constant)
+                           and isinstance(node.args[0].value, str)))):
+                # CostBook.wrap("entry", fn, ...) jits fn behind the
+                # cost-observatory dispatcher; treat it as a jit root so
+                # trace-safety coverage survives the indirection.  The
+                # string(-literal or f-string) first argument keeps this
+                # from matching unrelated wrap() methods (e.g. chaos
+                # client wrapping).
+                targets = harvest(node.args[1], scope)
+                direct = [t for t in targets if isinstance(t, FuncInfo)] \
+                    if isinstance(node.args[1],
+                                  (ast.Name, ast.Attribute, ast.Lambda)) \
+                    else []
+                nums, names = _static_info(node)
+                self.sites.append(JitSite(
+                    self.syms.rel, node.lineno, node, targets, direct,
+                    nums, names, "jit"))
         self.generic_visit(node)
 
 

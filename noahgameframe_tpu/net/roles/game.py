@@ -644,6 +644,12 @@ class GameRole(ServerRole):
         # parses this into the cluster-wide stage waterfall
         ext.key.append(b"pipeline")
         ext.value.append(_json.dumps(self.pipeline_stats()).encode())
+        # compiled-cost heartbeat: compact CostBook summary (per-entry
+        # compiles/recompiles/flops/bytes + HBM live/peak) — the master's
+        # /costbook route aggregates these into the cluster view
+        ext.key.append(b"costbook")
+        ext.value.append(
+            _json.dumps(self.kernel.costbook.summary()).encode())
         return r
 
     def pipeline_stats(self) -> dict:
@@ -1888,6 +1894,13 @@ class GameRole(ServerRole):
             sc.frame_end()
             if flushed and self._trace_sample > 0:
                 self._emit_frame_traces()
+        if tick_due:
+            # periodic HBM census: live/peak device bytes sampled in-band
+            # (scrape-time sampling alone misses peaks between scrapes)
+            from ...telemetry.costbook import HBM_SAMPLE_FRAMES
+
+            if self.kernel.tick_count % HBM_SAMPLE_FRAMES == 0:
+                self.kernel.costbook.hbm_sample()
         # periodic autosave: device-side deaths free the row before any
         # BEFORE_DESTROY hook can run, so the blob must already be fresh
         if (self.data_agent is not None
@@ -2512,7 +2525,9 @@ class GameRole(ServerRole):
                 )
                 return q, res.rows, res.ok & obs_valid[:, None]
 
-        fn = jax.jit(step)
+        fn = self.kernel.costbook.wrap(
+            f"interest.step/{cname}", step, stage="interest"
+        )
         self._interest_jit[key] = fn
         return fn
 
@@ -2573,7 +2588,9 @@ class GameRole(ServerRole):
                 )
                 return res.rows, res.ok & obs_valid[:, None]
 
-        fn = jax.jit(query)
+        fn = self.kernel.costbook.wrap(
+            f"interest.query/{cname}", query, stage="interest"
+        )
         self._interest_jit[key] = fn
         return fn
 
@@ -2847,7 +2864,9 @@ class GameRole(ServerRole):
                 )
                 return q, qver2, prev2, table.payload
 
-        fn = jax.jit(prep)
+        fn = self.kernel.costbook.wrap(
+            f"serve.prepare/{cname}", prep, stage="interest"
+        )
         self._serve_jit[key] = fn
         return fn
 
@@ -2900,7 +2919,9 @@ class GameRole(ServerRole):
                 SeenTable(seen_rows, seen_gen, seen_qver),
             )
 
-        fn = jax.jit(scan)
+        fn = self.kernel.costbook.wrap(
+            f"serve.scan/{cname}", scan, stage="interest"
+        )
         self._serve_jit[key] = fn
         return fn
 
@@ -3115,7 +3136,9 @@ class GameRole(ServerRole):
                 rows, counts = slot_compact(res.rows, ok)
                 return rows, counts
 
-        fn = jax.jit(query)
+        fn = self.kernel.costbook.wrap(
+            f"serve.query/{cname}", query, stage="interest"
+        )
         self._serve_jit[key] = fn
         return fn
 

@@ -98,6 +98,10 @@ class MasterRole(ServerRole):
             self.http.route("/", self._index_page)
             # Prometheus exposition rides the same status server
             self.telemetry.mount(self.http)
+            # the cluster-aggregated costbook view shadows the mount's
+            # per-role snapshot on the master (registered after mount so
+            # the later route wins): operators want the fleet rollup here
+            self.http.route("/costbook", lambda _p, _q: self.costbook_status())
 
     def _install(self) -> None:
         s = self.server
@@ -303,7 +307,46 @@ class MasterRole(ServerRole):
                 fo[str(sid)] = {"error": "unparseable failover ext"}
         if fo:
             status["failover"] = fo
+        # compiled-cost health: each game's heartbeat ext carries a
+        # compact CostBook summary; parse it into a structured block so
+        # the dashboard shows recompiles/HBM without scraping every world
+        cb = self._costbook_ext()
+        if cb:
+            status["costbook"] = cb
         return status
+
+    def _costbook_ext(self) -> Dict[str, dict]:
+        """Per-game CostBook summaries parsed from heartbeat ext blobs."""
+        out: Dict[str, dict] = {}
+        for sid, reg in sorted(
+            self.registry.get(int(ServerType.GAME), {}).items()
+        ):
+            blob = self._ext_of(reg.report).get("costbook")
+            if not blob:
+                continue
+            try:
+                out[str(sid)] = _json.loads(blob)
+            except ValueError:
+                out[str(sid)] = {"error": "unparseable costbook ext"}
+        return out
+
+    def costbook_status(self) -> dict:
+        """Cluster-wide compiled-cost view (/costbook): per-game CostBook
+        summaries plus cluster totals — the aggregate sibling of the
+        per-role /costbook snapshot served by TelemetryModule."""
+        games = self._costbook_ext()
+        totals = {"compiles": 0, "recompiles": 0, "compile_ms": 0.0,
+                  "hbm_live_bytes": 0, "hbm_peak_bytes": 0}
+        for g in games.values():
+            if "error" in g:
+                continue
+            totals["compiles"] += int(g.get("compiles", 0))
+            totals["recompiles"] += int(g.get("recompiles", 0))
+            totals["compile_ms"] += float(g.get("compile_ms", 0.0))
+            totals["hbm_live_bytes"] += int(g.get("hbm_live", 0) or 0)
+            totals["hbm_peak_bytes"] += int(g.get("hbm_peak", 0) or 0)
+        totals["compile_ms"] = round(totals["compile_ms"], 3)
+        return {"totals": totals, "games": games}
 
     def pipeline_status(self) -> dict:
         """Frame-pipeline waterfall for the whole cluster (/pipeline):

@@ -124,11 +124,11 @@ class ShardedKernel:
     def _compile(self):
         if self._jit_step is None:
             shardings = world_shardings(self.kernel.state, self.mesh)
-            self._jit_step = jax.jit(
-                self.kernel._trace_step,
-                in_shardings=(shardings,),
-                out_shardings=(shardings, None),
-                donate_argnums=0,
+            self._jit_step = self.kernel.costbook.wrap(
+                "kernel.sharded_step", self.kernel._trace_step,
+                donate_argnums=0, stage="tick",
+                jit_kwargs={"in_shardings": (shardings,),
+                            "out_shardings": (shardings, None)},
             )
         return self._jit_step
 
@@ -173,11 +173,11 @@ class ShardedKernel:
                 st2, _out = self.kernel._trace_step(st)
                 return st2
 
-            self._jit_step1 = jax.jit(
-                step1,
-                in_shardings=(shardings,),
-                out_shardings=shardings,
-                donate_argnums=0,
+            self._jit_step1 = self.kernel.costbook.wrap(
+                "kernel.sharded_step1", step1,
+                donate_argnums=0, stage="tick",
+                jit_kwargs={"in_shardings": (shardings,),
+                            "out_shardings": shardings},
             )
         return self._jit_step1
 
@@ -210,11 +210,12 @@ class ShardedKernel:
                 st2, _out = self.kernel._trace_step(st)
                 return st2
 
-            self._jit_run = jax.jit(
+            self._jit_run = self.kernel.costbook.wrap(
+                "kernel.sharded_run",
                 lambda st, k: jax.lax.fori_loop(0, k, body, st),
-                in_shardings=(shardings, None),
-                out_shardings=shardings,
-                donate_argnums=0,
+                donate_argnums=0, stage="tick",
+                jit_kwargs={"in_shardings": (shardings, None),
+                            "out_shardings": shardings},
             )
         self.kernel.state = self._jit_run(self.kernel.state, jnp.int32(key))
         self.kernel.tick_count += key

@@ -387,6 +387,11 @@ class SpatialWorld:
         self.max_bucket_boost = 8
         self._bucket_boost = 1
         self._step = None
+        # standalone cost ledger (the slab runs kernel-less); benches and
+        # tests read world.costbook directly
+        from ..telemetry.costbook import CostBook
+
+        self.costbook = CostBook()
 
     # -- placement --------------------------------------------------------
     def place(self, pos: np.ndarray, hp: np.ndarray, atk: np.ndarray,
@@ -455,7 +460,7 @@ class SpatialWorld:
             out_specs=(row,) * 14,
             **_SM_KW,
         )
-        return jax.jit(smapped)
+        return self.costbook.wrap("spatial.step", smapped, stage="tick")
 
     def step(self, n: int = 1) -> None:
         if self._step is None:
@@ -519,6 +524,8 @@ class SpatialWorld:
         g = self.geom
         self.geom = g._replace(bucket=g.bucket * 2, att_bucket=g.att_bucket * 2)
         self._step = None
+        # sanctioned retrace: the doubled buckets bake into the next trace
+        self.costbook.generation_bump("bucket_resize")
         st = self.state
         self.state = st._replace(
             vc_pos=jnp.zeros_like(st.vc_pos),

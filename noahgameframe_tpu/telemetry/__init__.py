@@ -25,11 +25,13 @@ from .registry import (
     MetricsRegistry,
     escape_label_value,
 )
+from .costbook import CostBook, roofline_fold
 from .tracing import SpanTracer
 from .module import TelemetryModule
 
 __all__ = [
     "CallbackMetric",
+    "CostBook",
     "Counter",
     "Gauge",
     "Histogram",
@@ -37,4 +39,5 @@ __all__ = [
     "SpanTracer",
     "TelemetryModule",
     "escape_label_value",
+    "roofline_fold",
 ]

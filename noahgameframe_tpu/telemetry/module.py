@@ -25,9 +25,11 @@ one for roles without a status server.
 
 from __future__ import annotations
 
+import json
 from typing import Dict, Iterable, Optional, Tuple
 
 from ..kernel.module import Module
+from .costbook import CostBook
 from .registry import MetricsRegistry, CONTENT_TYPE  # noqa: F401
 from .tracing import SpanTracer
 
@@ -51,6 +53,11 @@ class TelemetryModule(Module):
             ),
         )
         self.census = MemoryCensus()
+        # the device cost observatory: replaced by the kernel's book in
+        # attach_kernel so one ledger covers kernel + serve-edge entries;
+        # roles without a kernel keep this (empty) one so /costbook is
+        # uniform across all five roles
+        self.costbook = CostBook()
         self._net_sources: Dict[str, object] = {}
         self._pool_sources: Dict[str, object] = {}  # link -> NetClientModule
         self._chaos_sources: list = []  # (link prefix, ChaosDirector)
@@ -85,8 +92,72 @@ class TelemetryModule(Module):
             "nf_chaos_faults_total", self._chaos_samples, kind="counter",
             help="injected faults per link and kind (net/chaos.py)",
         )
+        # cost observatory (telemetry/costbook.py): lambdas read
+        # self.costbook dynamically so attach_kernel's adoption of the
+        # kernel's book retargets every series
+        self.registry.register_callback(
+            "nf_recompiles_total",
+            lambda: self.costbook.recompile_samples(), kind="counter",
+            help="jit retraces per entry with cause attribution",
+        )
+        self.registry.register_callback(
+            "nf_compiles_total",
+            lambda: self.costbook.compile_samples(0), kind="counter",
+            help="XLA compiles per jit entry (first trace included)",
+        )
+        self.registry.register_callback(
+            "nf_compile_seconds_total",
+            lambda: self.costbook.compile_samples(1), kind="counter",
+            help="cumulative lowering+compile wall seconds per entry",
+        )
+        self.registry.register_callback(
+            "nf_entry_flops",
+            lambda: self.costbook.cost_samples("flops"), kind="gauge",
+            help="cost_analysis FLOPs of each entry's latest executable",
+        )
+        self.registry.register_callback(
+            "nf_entry_bytes_accessed",
+            lambda: self.costbook.cost_samples("bytes_accessed"),
+            kind="gauge",
+            help="cost_analysis bytes accessed per entry (latest)",
+        )
+        self.registry.register_callback(
+            "nf_entry_temp_bytes",
+            lambda: self.costbook.cost_samples("temp_bytes"), kind="gauge",
+            help="memory_analysis temp buffer bytes per entry (latest)",
+        )
+        self.registry.register_callback(
+            "nf_hbm_bytes_in_use", self._hbm_samples_live, kind="gauge",
+            help="device allocator live bytes (memory_stats; "
+                 "live-array fallback on backends without stats)",
+        )
+        self.registry.register_callback(
+            "nf_hbm_peak_bytes", lambda: self._hbm_samples_cached(
+                "peak_bytes"), kind="gauge",
+            help="device allocator peak bytes since process start",
+        )
+        self.registry.register_callback(
+            "nf_hbm_bytes_limit", lambda: self._hbm_samples_cached(
+                "limit_bytes"), kind="gauge",
+            help="device allocator capacity (0 when unknown)",
+        )
 
     # ------------------------------------------------------------ sources
+    def _hbm_samples_live(self) -> Iterable[Tuple[dict, float]]:
+        """Scrape-time census pass (the periodic frame-loop sampling in
+        GameRole covers unscraped stretches); the peak/limit gauges read
+        the refreshed cache so one scrape is one census."""
+        hbm = self.costbook.hbm_sample()
+        yield ({}, float(hbm["live_bytes"]))
+        for d in hbm["per_device"]:
+            yield ({"device": d["device"]}, float(d["live_bytes"]))
+
+    def _hbm_samples_cached(self, key: str) -> Iterable[Tuple[dict, float]]:
+        hbm = self.costbook.hbm or self.costbook.hbm_sample()
+        yield ({}, float(hbm.get(key, 0)))
+        for d in hbm.get("per_device", ()):
+            yield ({"device": d["device"]}, float(d.get(key, 0)))
+
     def _frame_quantiles(self) -> Iterable[Tuple[dict, float]]:
         h = self.tick.hist
         for q in (50, 95, 99):
@@ -169,6 +240,15 @@ class TelemetryModule(Module):
         self._kernel_attached = True
         self.census.kernel = kernel
         kernel.tracer = self.tracer
+        # one CostBook per world: the kernel built its own at
+        # construction (bare-kernel benches record into it before any
+        # telemetry exists); adopt it so role-level entries (serve,
+        # interest) and kernel entries share a ledger
+        kbook = getattr(kernel, "costbook", None)
+        if kbook is not None:
+            self.costbook = kbook
+        else:
+            kernel.costbook = self.costbook
         reg = self.registry
         reg.register_callback(
             "nf_ticks_total", lambda: kernel.tick_count, kind="counter",
@@ -261,9 +341,17 @@ class TelemetryModule(Module):
             pass
 
     # ------------------------------------------------------------ expose
+    def costbook_handler(self, _path=None, _params=None):
+        """HTTP handler for ``/costbook``: the book's full snapshot with
+        a fresh HBM census folded in."""
+        self.costbook.hbm_sample()
+        body = json.dumps(self.costbook.snapshot()).encode()
+        return 200, "application/json", body
+
     def mount(self, http) -> None:
-        """Route /metrics on an existing HttpServer."""
+        """Route /metrics and /costbook on an existing HttpServer."""
         http.route("/metrics", self.registry.handler)
+        http.route("/costbook", self.costbook_handler)
 
     def exposition(self) -> str:
         return self.registry.exposition()

@@ -65,6 +65,15 @@ def main() -> None:
     combat = world.combat
     spec = k.store.spec("NPC")
 
+    # every timed pass routes through the kernel's CostBook — the pass
+    # list, per-pass compile wall time and compiled FLOPs/bytes land in
+    # ONE ledger shared with bench.py's detail block (the fused tick is
+    # already in it as "kernel.run")
+    book = k.costbook
+
+    def wrap(name, fn):
+        return book.wrap(f"pass.{name}", fn, stage="profile")
+
     dev = jax.devices()[0]
     out: dict = {
         "metric": "pass_ms",
@@ -121,12 +130,13 @@ def main() -> None:
     key = jnp.where(alive, cell_of(pos, cell_size, width), n_cells)
     key = jax.block_until_ready(jax.jit(lambda x: x)(key))
 
-    timed("argsort_xla", jax.jit(jnp.argsort), key)
+    timed("argsort_xla", wrap("argsort_xla", jnp.argsort), key)
     bits = _bits_for(n_cells)
     for b in (1, 2, 3):  # binary / 4-way / 8-way digit variants
         timed(
             f"argsort_radix_b{b}",
-            jax.jit(lambda kk, b=b: _radix_argsort(kk, bits, b)),
+            wrap(f"argsort_radix_b{b}",
+                 lambda kk, b=b: _radix_argsort(kk, bits, b)),
             key,
         )
 
@@ -158,10 +168,11 @@ def main() -> None:
         return CellTable(payload, slot_of, jnp.int32(0), width, cell_size,
                          att_bucket)
 
-    build = jax.jit(
+    build = wrap(
+        "build_pair_tables",
         lambda p, al, vf, am, af: build_cell_table_pair(
             p, al, vf, am, af, cell_size, width, bucket, att_bucket
-        )
+        ),
     )
     timed("build_pair_tables", build, pos, alive, vic_feats, attacking, att_feats)
     vic_table, att_table = jax.block_until_ready(
@@ -174,21 +185,23 @@ def main() -> None:
     # build_pair_tables above so the A/B decomposes per pass -------------
     timed(
         "count_histogram",
-        jax.jit(lambda kk: _cell_counts(kk, n_cells)),
+        wrap("count_histogram", lambda kk: _cell_counts(kk, n_cells)),
         key,
     )
     timed(
         "count_rank_rounds",  # bucket rounds of scatter-min over [N]
-        jax.jit(lambda kk: _counting_ranks(kk, n_cells, bucket)),
+        wrap("count_rank_rounds",
+             lambda kk: _counting_ranks(kk, n_cells, bucket)),
         key,
     )
     timed(
         "count_build_pair",  # full sort-free twin of build_pair_tables
-        jax.jit(
+        wrap(
+            "count_build_pair",
             lambda kk, al, vf, am, af: _build_pair_counting(
                 vf, al, am, af, kk, n_cells, cell_size, width, bucket,
                 att_bucket,
-            )
+            ),
         ),
         key, alive, vic_feats, attacking, att_feats,
     )
@@ -204,29 +217,25 @@ def main() -> None:
 
     skin = 2.0  # representative; geometry stays the bench world's own
     fresh = init_cache(cap)  # all-False anchor: every refresh rebuilds
-    timed(
+    reb = wrap(
         "verlet_rebuild",
-        jax.jit(lambda c, p, al: refresh(c, p, al, cell_size, width, bucket,
-                                         skin)),
-        fresh, pos, alive,
+        lambda c, p, al: refresh(c, p, al, cell_size, width, bucket, skin),
     )
-    warm, _ = jax.block_until_ready(
-        jax.jit(lambda c, p, al: refresh(c, p, al, cell_size, width, bucket,
-                                         skin))(fresh, pos, alive)
-    )
+    timed("verlet_rebuild", reb, fresh, pos, alive)
+    warm, _ = jax.block_until_ready(reb(fresh, pos, alive))
     timed(
         "verlet_reuse",  # anchored at these exact positions: zero motion
-        jax.jit(lambda c, p, al: refresh(c, p, al, cell_size, width, bucket,
-                                         skin)),
+        reb,             # same program — the cache vote decides at runtime
         warm, pos, alive,
     )
     timed(
         "verlet_cached_tables",  # the payload replay both tables run on a
-        jax.jit(                 # reuse tick — the argsort-free build half
+        wrap(                    # reuse tick — the argsort-free build half
+            "verlet_cached_tables",
             lambda c, al, vf, am, af: (
                 v_full(c, vf, al, n_cells, cell_size, width, bucket),
                 v_sub(c, am, af, n_cells, cell_size, width, att_bucket),
-            )
+            ),
         ),
         warm, alive, vic_feats, attacking, att_feats,
     )
@@ -236,16 +245,19 @@ def main() -> None:
     occ = jnp.concatenate([vic_feats, jnp.ones((cap, 1), f32)], -1)
     timed(
         "payload_scatter",
-        jax.jit(
-            lambda so, ft: jnp.zeros((dump + 1, ft.shape[-1]), f32).at[so].set(ft)
+        wrap(
+            "payload_scatter",
+            lambda so, ft: jnp.zeros((dump + 1, ft.shape[-1]),
+                                     f32).at[so].set(ft),
         ),
         vic_table.slot_of, occ,
     )
     slot_res = jnp.zeros((width, width, bucket, 2), jnp.int32)
     timed(
         "pull_gather",
-        jax.jit(lambda so, r: pull(mk_vic(vic_table.payload, so), r,
-                                   fill=(0, -1))),
+        wrap("pull_gather",
+             lambda so, r: pull(mk_vic(vic_table.payload, so), r,
+                                fill=(0, -1))),
         vic_table.slot_of, slot_res,
     )
 
@@ -258,7 +270,9 @@ def main() -> None:
 
     timed(
         "fold_xla",
-        jax.jit(lambda vp, vs, ap, as_: fold_xla(mk_vic(vp, vs), mk_att(ap, as_))),
+        wrap("fold_xla",
+             lambda vp, vs, ap, as_: fold_xla(mk_vic(vp, vs),
+                                              mk_att(ap, as_))),
         vic_table.payload, vic_table.slot_of,
         att_table.payload, att_table.slot_of,
     )
@@ -267,13 +281,15 @@ def main() -> None:
         from noahgameframe_tpu.ops.stencil_pallas import combat_fold_pallas
 
         interp = jax.default_backend() not in ("tpu", "axon")
+        pname = "fold_pallas" + ("_interpret" if interp else "")
         timed(
-            "fold_pallas" + ("_interpret" if interp else ""),
-            jax.jit(
+            pname,
+            wrap(
+                pname,
                 lambda vp, vs, ap, as_: combat_fold_pallas(
                     mk_vic(vp, vs), mk_att(ap, as_), combat.radius,
                     interpret=interp,
-                )
+                ),
             ),
             vic_table.payload, vic_table.slot_of,
             att_table.payload, att_table.slot_of,
@@ -281,6 +297,9 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         out["passes"]["fold_pallas"] = f"ERROR {type(e).__name__}: {e}"
 
+    # compile/cost ledger for the whole pass list — same schema as the
+    # /costbook route, so pass profiles and BENCH detail join on entry
+    out["costbook"] = book.snapshot()
     print(json.dumps(out))
 
 

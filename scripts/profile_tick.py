@@ -63,6 +63,10 @@ def main() -> None:
     world = build_benchmark_world(args.entities, combat=not args.no_combat, seed=42)
     k = world.kernel
     state = k.state
+    # every timed prefix is a CostBook entry: phase attribution, compile
+    # wall and compiled FLOPs/bytes share one ledger with profile_passes
+    # and bench.py instead of re-deriving the phase list
+    book = k.costbook
 
     def prefix_fn(n_phases: int):
         def f(st):
@@ -79,20 +83,22 @@ def main() -> None:
                 st = ph.fn(st, ctx)
             return st.replace(tick=st.tick + 1)
 
-        return jax.jit(f)
+        return f
 
     names = ["schedule"] + [p.name for p in k._composed]
     report = {}
     prev = 0.0
     for i in range(len(k._composed) + 1):
-        ms = _timeit(prefix_fn(i), state, args.iters)
         label = names[i] if i < len(names) else f"phase{i}"
+        fn = book.wrap(f"prefix.{label}", prefix_fn(i), stage="profile")
+        ms = _timeit(fn, state, args.iters)
         report[label] = round(ms - prev, 3)
         report[f"_cum_{label}"] = round(ms, 3)
         prev = ms
         print(f"  prefix {i:2d} ({label:12s}): {ms:8.2f} ms  (+{report[label]:.2f})", flush=True)
 
-    full = jax.jit(lambda st: k._trace_step(st))
+    full = book.wrap("prefix.full_step", lambda st: k._trace_step(st),
+                     stage="profile")
     ms_full = _timeit(full, state, args.iters)
     report["diff_epilogue"] = round(ms_full - prev, 3)
     report["full_step"] = round(ms_full, 3)
@@ -121,7 +127,8 @@ def main() -> None:
             )
             return vt.payload, at.payload
 
-        build = jax.jit(both_builds)
+        build = book.wrap("pass.combat_build_only", both_builds,
+                          stage="profile")
         report["combat_build_only"] = round(_timeit(build, pos, args.iters), 3)
         report["combat_geometry"] = {
             "width": combat.width,
@@ -136,7 +143,8 @@ def main() -> None:
         )
 
     dev = jax.devices()[0]
-    print(json.dumps({"device": str(dev), "entities": args.entities, "profile": report}))
+    print(json.dumps({"device": str(dev), "entities": args.entities,
+                      "profile": report, "costbook": book.snapshot()}))
 
 
 if __name__ == "__main__":

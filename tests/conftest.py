@@ -21,6 +21,14 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 assert jax.devices()[0].platform == "cpu", "tests must run on the CPU mesh"
 
+# Do NOT enable the persistent compile cache (NF_COMPILE_CACHE) here:
+# on the CPU backend a deserialized executable is not bit-identical to
+# the freshly compiled one, which breaks the bit-exactness contracts
+# the suite asserts (replay digests, gameday fault-free controls) and
+# can abort the process outright.  bench/profilers may cache; tests
+# must compile fresh.
+os.environ.pop("NF_COMPILE_CACHE", None)
+
 
 def pytest_configure(config):
     # tier-1 runs with -m 'not slow'; the long soaks opt out via this mark
