@@ -578,6 +578,125 @@ def run_sharded(args) -> dict:
     }
 
 
+def run_mesh_migrate(args) -> dict:
+    """ISSUE 15 r09 evidence: the unified engine's full-row migration
+    ladder.  Sweeps entity count x mesh width x migration budget through
+    the ONE engine (SpatialWorld as a thin preset over Kernel +
+    ShardedKernel + RowMigrationModule) on virtual CPU devices —
+    config-5 shape.  Each point reports throughput, migration traffic
+    (rows and analytic collective bytes = row_bytes x migrated), and a
+    CostBook recompile gate: after the 2-tick warmup, the sweep loop
+    must compile NOTHING new (`unexplained_recompiles == 0`)."""
+    from noahgameframe_tpu.utils.platform import force_cpu, init_compile_cache
+
+    jax = force_cpu(args.mesh_migrate)
+    init_compile_cache()
+
+    import numpy as np
+
+    from noahgameframe_tpu.ops.stencil import auto_bucket, binning_mode
+    from noahgameframe_tpu.parallel.spatial import SpatialGeom, SpatialWorld
+
+    entities = [int(x) for x in
+                (args.mig_entities or "100000,1000000").split(",")]
+    if args.mig_widths:
+        widths = [int(x) for x in args.mig_widths.split(",")]
+    else:
+        widths = [w for w in (2, 4, 8) if w <= args.mesh_migrate] or [1]
+    budgets = [int(x) for x in (args.mig_budgets or "2048,8192").split(",")]
+    ticks = args.mig_ticks
+
+    def point(n, shards, budget):
+        radius = 4.0
+        cell = 4.0
+        extent = max(64.0, float(np.sqrt(n / 0.4)))
+        width = max(shards, int(extent / cell))
+        width -= width % shards
+        extent = width * cell
+        bucket = auto_bucket(n, width) + 8
+        att_bucket = auto_bucket(max(1, n // 30), width, lo=4, align=2) + 4
+        geom = SpatialGeom(
+            extent=extent, cell_size=cell, width=width, n_shards=shards,
+            bucket=bucket, att_bucket=att_bucket, radius=radius,
+            mig_budget=budget, speed=1.0, attack_period=30,
+        )
+        rng = np.random.default_rng(args.seed)
+        pos = rng.uniform(1.0, extent - 1.0, (n, 2)).astype(np.float32)
+        hp = np.full(n, 10_000, np.int32)
+        atk = rng.integers(5, 20, n).astype(np.int32)
+        camp = (np.arange(n) % 2).astype(np.int32)
+        world = SpatialWorld(geom)
+        world.place(pos, hp, atk, camp)
+        t_c0 = time.perf_counter()
+        world.step(2)  # compile + warm (stats fetch path included)
+        compile_s = time.perf_counter() - t_c0
+        mark = world.costbook.mark()
+        migrated = overflow = dropped = 0
+        t0 = time.perf_counter()
+        for _ in range(ticks):
+            world.step(1)
+            s = world.stats_last.sum(axis=0)
+            migrated += int(s[0])
+            overflow += int(s[1])
+            dropped += int(s[2])
+        dt = time.perf_counter() - t0
+        unexplained = world.costbook.unexplained_since(mark)
+        row_b = world._mig.row_bytes() if world._mig is not None else 0
+        return {
+            "entities": n,
+            "devices": shards,
+            "mesh": str({"shard": shards}),
+            "mig_budget": budget,
+            "ticks": ticks,
+            "compile_plus_warm_s": round(compile_s, 2),
+            "tick_ms": round(1000 * dt / ticks, 3),
+            "entity_ticks_per_sec": round(n * ticks / dt, 1),
+            "migrated_total": migrated,
+            "mig_overflow_total": overflow,
+            "mig_dropped_total": dropped,
+            "row_bytes": row_b,
+            # analytic wire cost of the migration collective: every
+            # migrated row moves its FULL ClassState (banks + records +
+            # timers + alive) once
+            "migrate_collective_bytes_per_tick": (
+                row_b * migrated // max(1, ticks)
+            ),
+            "unexplained_recompiles": len(unexplained),
+            "geometry": {"width": width, "slab_h": geom.slab_h,
+                         "bucket": bucket, "att_bucket": att_bucket},
+            "costbook": _costbook_detail(world.costbook),
+        }
+
+    points = []
+    for n in entities:
+        for shards in widths:
+            for budget in budgets:
+                # full product at the smallest N ranks the knobs; larger
+                # Ns run the headline config only (CPU wall-clock bound)
+                if n != entities[0] and (shards != widths[-1]
+                                         or budget != budgets[-1]):
+                    continue
+                points.append(point(n, shards, budget))
+    best = max(points, key=lambda p: p["entity_ticks_per_sec"])
+    return {
+        "metric": "mesh_migrate_entity_ticks_per_sec",
+        "value": best["entity_ticks_per_sec"],
+        "unit": "entity-ticks/s",
+        "vs_baseline": round(best["entity_ticks_per_sec"] / NORTH_STAR_RATE,
+                             4),
+        "detail": {
+            "devices": args.mesh_migrate,
+            "seed": args.seed,
+            "platform": jax.devices()[0].platform,
+            "binning": binning_mode(),
+            "engine": "unified (full-row ClassState migration)",
+            "unexplained_recompiles": sum(p["unexplained_recompiles"]
+                                          for p in points),
+            "points": points,
+        },
+    }
+
+
 def run_bench(args) -> dict:
     import jax
 
@@ -998,6 +1117,31 @@ def main() -> None:
              "(BASELINE config-5 evidence) instead of the single-chip loop",
     )
     ap.add_argument(
+        "--mesh-migrate", type=int, default=0, metavar="N",
+        help="unified-engine migration ladder over N virtual CPU "
+             "devices: entity count x mesh width x migration budget "
+             "through the full-row ClassState migration, with a "
+             "CostBook zero-unexplained-recompile gate (r09 evidence)",
+    )
+    ap.add_argument(
+        "--mig-entities", default=None, metavar="N,N,...",
+        help="mesh-migrate entity ladder (default 100000,1000000; the "
+             "full knob product runs at the smallest count only)",
+    )
+    ap.add_argument(
+        "--mig-widths", default=None, metavar="S,S,...",
+        help="mesh-migrate mesh widths in shards (default 2,4,8 "
+             "clipped to --mesh-migrate)",
+    )
+    ap.add_argument(
+        "--mig-budgets", default=None, metavar="B,B,...",
+        help="mesh-migrate per-direction row budgets (default 2048,8192)",
+    )
+    ap.add_argument(
+        "--mig-ticks", type=int, default=10,
+        help="timed ticks per mesh-migrate point (after a 2-tick warmup)",
+    )
+    ap.add_argument(
         "--platform",
         choices=("auto", "tpu", "cpu"),
         default="auto",
@@ -1021,6 +1165,27 @@ def main() -> None:
         if args.ticks is None:
             args.ticks = 8
         _emit(_run_session_sweep(args))
+        return
+
+    if args.mesh_migrate:
+        try:
+            _emit(run_mesh_migrate(args))
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            _emit(
+                {
+                    "metric": "mesh_migrate_entity_ticks_per_sec",
+                    "value": 0.0,
+                    "unit": "entity-ticks/s",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}",
+                    "detail": {
+                        "trace_tail": traceback.format_exc().strip()
+                        .splitlines()[-4:],
+                    },
+                }
+            )
         return
 
     probe_note = None

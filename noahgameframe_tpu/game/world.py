@@ -69,6 +69,10 @@ class WorldConfig:
     # private is included so owner-only state (EXP, Gold, bag counters)
     # reaches its own client (GetBroadCastObject: Private -> self)
     diff_flags: tuple = ("public", "private", "upload")
+    # config-selected spatial placement: a parallel.SpatialPlacement makes
+    # GameWorld attach the full-row cross-shard migration phase (the
+    # unified mesh engine); None keeps the world single-shard
+    placement: Optional["object"] = None
 
 
 class GameWorld:
@@ -150,6 +154,12 @@ class GameWorld:
         if cfg.regen:
             self.regen = RegenModule(period_s=cfg.regen_period_s)
             modules.append(self.regen)
+        self.migration = None
+        if cfg.placement is not None:
+            from ..parallel.rowmigrate import RowMigrationModule
+
+            self.migration = RowMigrationModule(cfg.placement)
+            modules.append(self.migration)
         # observability: registry + tracer + census, kernel-attached via
         # the pm lifecycle (after_init runs post kernel.build)
         from ..telemetry import TelemetryModule

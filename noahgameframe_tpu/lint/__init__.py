@@ -35,6 +35,7 @@ from .rules_contracts import (
 )
 from .rules_determinism import UnseededRngRule, WallClockRule
 from .rules_serving import ServeLoopRule
+from .rules_store import MigrateCoversStoreRule
 from .rules_trace import RecompileHazardRule, TraceSafetyRule
 from .rules_wire import DispatchHandlerRule, StructCodecRule
 
@@ -51,6 +52,7 @@ ALL_RULES = (
     StructCodecRule,
     DispatchHandlerRule,
     ServeLoopRule,
+    MigrateCoversStoreRule,
 )
 
 RULES_BY_NAME = {cls.name: cls for cls in ALL_RULES}

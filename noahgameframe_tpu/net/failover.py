@@ -401,11 +401,16 @@ class FailoverDriver:
         info = p.info
         selfid = Ident(svrid=info.selfid[0], index=info.selfid[1])
         client = Ident(svrid=info.client_id[0], index=info.client_id[1])
+        # frame the recovered blob with the shared row-blob CRC
+        # (persist/rowblob.py) so the target distinguishes "torn in
+        # transit" from "was empty"; an empty basis stays empty
+        from ..persist.rowblob import frame_blob
+
         data = SwitchServerData(
             selfid=selfid,
             account=info.account.encode(),
             name=info.name.encode(),
-            blob=p.blob,
+            blob=frame_blob(p.blob) if p.blob else p.blob,
             target_serverid=int(target),
         )
         req = ReqSwitchServer(

@@ -1537,13 +1537,17 @@ class GameRole(ServerRole):
                       scene_id: int = 1, group: int = 0) -> bool:
         """ChangeServer (NFCGSSwichServerModule.cpp:49-77)."""
         from ...persist.codec import snapshot_object
+        from ...persist.rowblob import frame_blob
 
         key = self._guid_session.get(guid)
         sess = self.sessions.get(key) if key is not None else None
         if sess is None or target_server_id == self.config.server_id:
             return False
         k = self.kernel
-        blob = snapshot_object(k.store, k.state, guid)
+        # CRC-framed (persist/rowblob.py) so the target detects a blob
+        # torn in transit before the codec ever parses it — the same
+        # row-serialization story the on-mesh migration shares
+        blob = frame_blob(snapshot_object(k.store, k.state, guid))
         ident = guid_ident(guid)
         data = SwitchServerData(
             selfid=ident,
@@ -1607,6 +1611,7 @@ class GameRole(ServerRole):
         torn in transit destroys the half-built object and refuses —
         the driver retries another survivor in every refusal case."""
         from ...persist.codec import apply_snapshot
+        from ...persist.rowblob import unframe_blob
         from ..failover import REFUSE_BAD_BLOB, REFUSE_BUSY
         _, req = unwrap(body, ReqSwitchServer)
         if int(req.target_serverid) != self.config.server_id:
@@ -1643,7 +1648,10 @@ class GameRole(ServerRole):
         )
         if data.blob:
             try:
-                k.state = apply_snapshot(k.store, k.state, guid, data.blob)
+                # unframe validates CRC/length fail-closed; a legacy
+                # (unframed) blob passes through to the codec unchanged
+                k.state = apply_snapshot(k.store, k.state, guid,
+                                         unframe_blob(data.blob))
             except Exception:
                 # torn blob: k.state only mutates on success, so a clean
                 # destroy admits nothing half-applied

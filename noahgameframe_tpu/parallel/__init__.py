@@ -13,13 +13,25 @@ from .multihost import (
     rendezvous_via_master,
     serve_dist,
 )
+from .rowmigrate import (
+    RowMigrationModule,
+    SpatialPlacement,
+    canonical_digest,
+    mesh_migrate_class,
+    migrate_rows,
+)
 from .shard import ShardedKernel, shard_rows_by_cell, world_shardings
 from .spatial import SpatialGeom, SpatialState, SpatialWorld
 
 __all__ = [
     "DistRendezvous",
+    "RowMigrationModule",
+    "SpatialPlacement",
+    "canonical_digest",
     "global_mesh",
     "init_distributed",
+    "mesh_migrate_class",
+    "migrate_rows",
     "rendezvous_via_master",
     "serve_dist",
     "SHARD_AXIS",

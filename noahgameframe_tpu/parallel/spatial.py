@@ -1,31 +1,25 @@
-"""Spatially-sharded combat core: slab partition, halo exchange, migration.
+"""Spatially-sharded combat preset over the unified mesh engine.
 
-The default sharded world (`parallel/shard.py`) shards the ENTITY axis
-and lets XLA partition the cell-table argsort — correct, but the
-partitioned sort is a global all-to-all every tick and was the round-3
-sharded-compile/latency hotspot.  This module is the TPU-first
-alternative the round-4 verdict asked to explore: partition SPACE, not
-rows.
+Historically this module owned a bespoke six-column mini-world
+(pos/hp/atk/camp/gid in its own NamedTuple banks) that bypassed the
+Kernel's property banks, records and timers entirely.  It is now a THIN
+PRESET over the one mesh engine: entities live in a real ``ClassState``
+("spatial" class: five int properties + a vector2 position), the tick is
+``Kernel._trace_step`` compiled by ``ShardedKernel``, and cross-shard
+migration is the generic full-row protocol in ``parallel/rowmigrate.py``
+(free-slot capacity vote → pack → ppermute → scatter-insert, lifted from
+the slab engine and generalized to every store leaf).
 
-Design (scaling-book recipe: pick a mesh, keep collectives O(boundary)):
+Phase chain (one jit-compiled sharded tick):
 
-- The [width x width] cell grid is cut into `n_shards` horizontal slabs
-  of `slab_h` cell rows; shard i owns slab i and the entities inside it.
-- Each tick, every shard builds its OWN cell table (argsort over
-  capacity/n_shards rows — the sort shrinks with the mesh instead of
-  becoming a distributed sort).
-- The 3x3 stencil fold needs attacker candidates from the one cell row
-  beyond each slab edge: shards exchange their edge attacker PLANES
-  ([1, W, K_att, F] — dense, fixed-size) with both neighbors via
-  `lax.ppermute`, then fold locally over [slab_h + 2] rows.  Bytes on
-  the wire per tick are O(W * K_att), independent of entity count.
-- Entities whose cell crossed a slab boundary MIGRATE: up to
-  `mig_budget` rows per direction per tick are packed, `ppermute`d to
-  the neighbor shard, and scattered into free bank slots — real
-  cross-shard migration (BASELINE config 5), with overflow counters
-  when the budget or the destination bank is full.  A row that could
-  not migrate stays home and simply misses combat that tick (counted,
-  like a cell-bucket overflow) and retries next tick.
+- ``spatial.walk`` (order 10): deterministic per-gid random walk, pure
+  elementwise — identical math on any placement.
+- ``rowmigrate.migrate`` (order 20): budgeted ppermute migration of FULL
+  ClassState rows toward the shard owning their cell row.  Up to
+  `mig_budget` rows per direction per tick; overflow rows stay home,
+  miss combat that tick (counted) and retry.
+- ``spatial.combat`` (order 30): per-slab cell tables, dense halo planes
+  to both neighbors, the shared combat fold, damage/regen/respawn.
 
 Damage semantics are bit-identical to the single-device engine: the
 fold body is game.combat.combat_fold_closure (shared, not copied), the
@@ -34,9 +28,13 @@ sums are exact int32 in f32 (< 2^24), and tie-breaks reduce over gid —
 so within migration/bucket budgets, spatial and single-device worlds
 produce identical HP trajectories (tests/test_spatial.py pins this).
 
+Verlet/binning caches ride ``WorldState.aux`` (never ClassState): they
+are rebuilt, not migrated, and stay excluded from ``state_digest`` — the
+cache-rebuild contract documented in docs/ARCHITECTURE.md.
+
 Reference contrast: NFCWorldNet_ServerModule.cpp:600-830 re-homes
 players between game servers through the World relay (serialize,
-destroy, recreate); here migration is two fixed-size collectives inside
+destroy, recreate); here migration is fixed-size collectives inside
 the jitted tick and visibility across the boundary is a dense halo, not
 a relay hop.
 """
@@ -49,22 +47,28 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core.schema import ClassDef, ClassRegistry, prop
+from ..core.store import StoreConfig, with_class
 from ..game.combat import combat_fold_closure
+from ..kernel.kernel import Kernel
+from ..kernel.module import Module
 from ..ops.stencil import binning_mode, build_cell_table_pair, pull
 from ..ops.verlet import VerletCache, full_table, refresh, sub_table
 from .mesh import SHARD_AXIS, make_mesh
+from .rowmigrate import (
+    _SM_KW,
+    _pack_rows,  # noqa: F401  (re-export: the slab protocol's packer moved)
+    _shard_map,
+    RowMigrationModule,
+    SpatialPlacement,
+)
+from .shard import ShardedKernel
 
-# jax.shard_map landed as a top-level API (with check_vma) after 0.4.x;
-# older releases spell it jax.experimental.shard_map with check_rep.
-if hasattr(jax, "shard_map"):
-    _shard_map = jax.shard_map
-    _SM_KW = {"check_vma": False}
-else:  # pragma: no cover - exercised on jax<0.6 only
-    from jax.experimental.shard_map import shard_map as _shard_map
-
-    _SM_KW = {"check_rep": False}
+# i32 property columns of the "spatial" class, in definition order
+_HP, _ATK, _CAMP, _GID, _DIED = range(5)
+_POS = 0  # vec column
 
 
 class SpatialGeom(NamedTuple):
@@ -95,10 +99,22 @@ class SpatialGeom(NamedTuple):
     def slab_h(self) -> int:
         return self.width // self.n_shards
 
+    def placement(self, class_name: str = "spatial",
+                  pos_prop: str = "pos") -> SpatialPlacement:
+        """The rowmigrate config this geometry implies."""
+        return SpatialPlacement(
+            class_name=class_name, pos_prop=pos_prop, extent=self.extent,
+            cell_size=self.cell_size, width=self.width,
+            n_shards=self.n_shards, mig_budget=self.mig_budget,
+        )
+
 
 class SpatialState(NamedTuple):
-    """Per-entity banks, leading axis = n_shards * bank_size, sharded
-    row-wise so shard i holds rows [i*bank : (i+1)*bank]."""
+    """Host-facing VIEW of the unified engine's state, kept for API and
+    snapshot compatibility: column slices of the "spatial" ClassState
+    banks plus the aux-carried Verlet cache.  Leading axis =
+    n_shards * bank_size, sharded row-wise so shard i holds rows
+    [i*bank : (i+1)*bank]."""
 
     pos: jnp.ndarray     # [cap, 2] f32
     hp: jnp.ndarray      # [cap] i32
@@ -108,9 +124,7 @@ class SpatialState(NamedTuple):
     died: jnp.ndarray    # [cap] i32 — tick of death, -1 while alive
     active: jnp.ndarray  # [cap] bool
     # Verlet cache leaves (geom.skin > 0; carried zeros otherwise).
-    # Flattened VerletCache so the whole state stays one NamedTuple of
-    # row-sharded banks (cstat: [n_shards, 3] = rebuilds/reuses/age,
-    # one [1, 3] row per shard).
+    # cstat: [n_shards, 3] = rebuilds/reuses/age, one [1, 3] row per shard.
     vc_pos: jnp.ndarray      # [cap, 2] f32 — anchor positions
     vc_active: jnp.ndarray   # [cap] bool  — anchor in-slab mask
     vc_order: jnp.ndarray    # [cap] i32
@@ -140,20 +154,6 @@ def _walk(pos, gid, tick, geom: SpatialGeom):
     return jnp.clip(pos + step, eps, geom.extent - eps)
 
 
-def _pack_rows(sel, rank, budget, *arrays):
-    """Gather up to `budget` selected rows into fixed [budget] buffers.
-    sel: [n] bool, rank: [n] exclusive rank among selected.  Returns
-    (valid [budget] bool, packed arrays)."""
-    n = sel.shape[0]
-    idx = jnp.where(sel & (rank < budget), rank, budget)
-    valid = jnp.zeros((budget + 1,), bool).at[idx].set(sel)[:budget]
-    out = []
-    for a in arrays:
-        buf_shape = (budget + 1,) + a.shape[1:]
-        out.append(jnp.zeros(buf_shape, a.dtype).at[idx].set(a)[:budget])
-    return valid, out
-
-
 def _life_phases(geom: SpatialGeom, hp, died, incoming, tick):
     """Damage -> death mark -> regen -> respawn, shared verbatim by the
     spatial tick and the single-device parity oracle (pure elementwise,
@@ -176,11 +176,13 @@ def _life_phases(geom: SpatialGeom, hp, died, incoming, tick):
     return hp_after, died
 
 
-def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, died,
-                  active, vc_pos, vc_active, vc_order, vc_skey, vc_slot,
-                  cstat, tick):
-    """One tick on one shard (runs under shard_map; arrays are the
-    shard-local banks)."""
+def _combat_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, died,
+                 active, vc_pos, vc_active, vc_order, vc_skey, vc_slot,
+                 cstat, tick):
+    """Combat on one shard (runs under shard_map; arrays are the
+    shard-local banks).  Movement and migration already happened in
+    earlier phases; cells are re-derived from the post-migration
+    positions exactly as the old fused body did."""
     n = geom.n_shards
     hs = geom.slab_h
     w = geom.width
@@ -188,77 +190,9 @@ def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, died,
     fwd = [(i, (i + 1) % n) for i in range(n)]
     bwd = [(i, (i - 1) % n) for i in range(n)]
 
-    # -- movement (identical math on any placement) ----------------------
-    pos = _walk(pos, gid, tick, geom)
-
     cx = jnp.clip((pos[:, 0] / geom.cell_size).astype(jnp.int32), 0, w - 1)
     cy = jnp.clip((pos[:, 1] / geom.cell_size).astype(jnp.int32), 0, w - 1)
     owner = cy // hs
-
-    # -- migration: one budgeted ppermute per direction ------------------
-    migrated = jnp.int32(0)
-    mig_overflow = jnp.int32(0)
-    mig_dropped = jnp.int32(0)
-    banks = (pos, hp, atk, camp, gid, died)
-    for d, perm in ((1, fwd), (-1, bwd)):
-        # direction of travel, not exact neighbor: a row stranded 2+
-        # slabs from home (sustained budget overflow, or a teleport)
-        # hops one slab toward its owner per tick until it arrives —
-        # otherwise it would be excluded from combat forever
-        m = active & ((owner > me) if d == 1 else (owner < me))
-        # destination capacity vote: each shard advertises its free-slot
-        # count BEFORE clearing its own outbound rows (so the advertised
-        # number only understates reality), and the sender clamps its
-        # send to it — a row that would find no slot stays home and
-        # retries instead of leaving the source bank and being destroyed
-        # in flight.  Receiving the successor's count means permuting
-        # values BACKWARD (each shard sends its count to its predecessor).
-        free_cnt = jnp.sum(~active, dtype=jnp.int32)
-        remote_free = jax.lax.ppermute(
-            free_cnt, axis, bwd if d == 1 else fwd
-        )
-        cap_d = jnp.minimum(jnp.int32(geom.mig_budget), remote_free)
-        csum = jnp.cumsum(m.astype(jnp.int32))
-        sel = m & (csum <= cap_d)
-        migrated = migrated + jnp.sum(sel, dtype=jnp.int32)
-        mig_overflow = mig_overflow + jnp.sum(m, dtype=jnp.int32) - jnp.sum(
-            sel, dtype=jnp.int32
-        )
-        valid, packed = _pack_rows(sel, csum - 1, geom.mig_budget, *banks)
-        rvalid = jax.lax.ppermute(valid, axis, perm)
-        rpacked = [jax.lax.ppermute(b, axis, perm) for b in packed]
-        # wrap-around sends are impossible (owner is clipped into range),
-        # but mask the circular receive anyway for edge shards
-        sender_ok = (me - d >= 0) & (me - d < n)
-        rvalid = rvalid & sender_ok
-        active = active & ~sel
-        # insert into free slots: dest[j] = row index of the j-th free slot
-        free = ~active
-        frank = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
-        slots = jnp.where(free & (frank < geom.mig_budget), frank,
-                          geom.mig_budget)
-        dest = (
-            jnp.full((geom.mig_budget + 1,), pos.shape[0], jnp.int32)
-            .at[slots]
-            .set(jnp.arange(pos.shape[0], dtype=jnp.int32))[: geom.mig_budget]
-        )
-        dest_j = jnp.where(rvalid, dest, pos.shape[0])
-        # should-never-fire assertion counter: the sender clamped to our
-        # advertised free count, so every arriving row has a slot; any
-        # nonzero here is a protocol bug, not expected overflow
-        mig_dropped = mig_dropped + jnp.sum(
-            rvalid & (dest_j >= pos.shape[0]), dtype=jnp.int32
-        )
-        new_banks = []
-        for cur, rb in zip(banks, rpacked):
-            new_banks.append(cur.at[dest_j].set(rb, mode="drop"))
-        pos, hp, atk, camp, gid, died = new_banks
-        active = active.at[dest_j].set(True, mode="drop")
-        banks = (pos, hp, atk, camp, gid, died)
-        # re-derive cells for rows that just arrived
-        cx = jnp.clip((pos[:, 0] / geom.cell_size).astype(jnp.int32), 0, w - 1)
-        cy = jnp.clip((pos[:, 1] / geom.cell_size).astype(jnp.int32), 0, w - 1)
-        owner = cy // hs
 
     # -- local cell tables over the slab ---------------------------------
     in_slab = active & (owner == me)
@@ -340,18 +274,73 @@ def _spatial_body(geom: SpatialGeom, axis, pos, hp, atk, camp, gid, died,
     incoming = jnp.where(in_slab & (hp > 0), pulled, 0)
     hp, died = _life_phases(geom, hp, died, incoming, tick)
 
-    # columns: migrated, mig_overflow (budget), mig_dropped (no free
-    # slot), misplaced (awaiting retry), vic/att cell-bucket drops
+    # columns: misplaced (awaiting migration retry), vic/att cell-bucket
+    # drops — the migration counters ride rowmigrate's own stats aux
     stats = jnp.stack(
-        [migrated, mig_overflow, mig_dropped, misplaced,
-         vic_t.dropped, att_t.dropped]
-    )[None, :]  # [1, 6] per shard -> [n_shards, 6] outside
-    return (pos, hp, atk, camp, gid, died, active,
-            vc_pos, vc_active, vc_order, vc_skey, vc_slot, cstat, stats)
+        [misplaced, vic_t.dropped, att_t.dropped]
+    )[None, :]  # [1, 3] per shard -> [n_shards, 3] outside
+    return (hp, died, vc_pos, vc_active, vc_order, vc_skey, vc_slot,
+            cstat, stats)
+
+
+VC_AUX = "spatial.vc"
+COMBAT_STATS_AUX = "spatial.stats"
+
+
+class _SpatialModule(Module):
+    """Walk + combat phases of the spatial preset (migration is the
+    generic RowMigrationModule between them)."""
+
+    name = "spatial"
+
+    def __init__(self, world: "SpatialWorld"):
+        super().__init__()
+        self.world = world
+        self.add_phase("walk", self._walk_phase, order=10)
+        self.add_phase("combat", self._combat_phase, order=30)
+
+    def _walk_phase(self, state, ctx):
+        g = self.world.geom
+        cs = state.classes["spatial"]
+        new = _walk(cs.vec[:, _POS, :2], cs.i32[:, _GID], ctx.tick, g)
+        vec = cs.vec.at[:, _POS, 0].set(new[:, 0]).at[:, _POS, 1].set(
+            new[:, 1])
+        return with_class(state, "spatial", cs.replace(vec=vec))
+
+    def _combat_phase(self, state, ctx):
+        w = self.world
+        g = w.geom  # read at trace time: invalidate() picks up resizes
+        cs = state.classes["spatial"]
+        vc = state.aux[VC_AUX]
+        row, rep = P(w.axis), P()
+        smapped = _shard_map(
+            partial(_combat_body, g, w.axis),
+            mesh=w.mesh,
+            in_specs=(row,) * 13 + (rep,),
+            out_specs=(row,) * 9,
+            **_SM_KW,
+        )
+        (hp, died, vc_pos, vc_active, vc_order, vc_skey, vc_slot, cstat,
+         stats) = smapped(
+            cs.vec[:, _POS, :2], cs.i32[:, _HP], cs.i32[:, _ATK],
+            cs.i32[:, _CAMP], cs.i32[:, _GID], cs.i32[:, _DIED],
+            cs.alive, vc["pos"], vc["active"], vc["order"], vc["skey"],
+            vc["slot"], vc["cstat"], ctx.tick,
+        )
+        i32 = cs.i32.at[:, _HP].set(hp).at[:, _DIED].set(died)
+        state = with_class(state, "spatial", cs.replace(i32=i32))
+        ctx.count("misplaced", jnp.sum(stats[:, 0]))
+        ctx.count("grid_drops", jnp.sum(stats[:, 1:]))
+        return state.replace(aux={
+            **state.aux,
+            VC_AUX: {"pos": vc_pos, "active": vc_active, "order": vc_order,
+                     "skey": vc_skey, "slot": vc_slot, "cstat": cstat},
+            COMBAT_STATS_AUX: stats,
+        })
 
 
 class SpatialWorld:
-    """Host wrapper: placement, compiled step, counters.
+    """Thin spatial preset over the unified Kernel/ShardedKernel engine.
 
     Usage:
         geom = SpatialGeom(...)
@@ -374,8 +363,6 @@ class SpatialWorld:
         self.mesh = mesh if mesh is not None else make_mesh(geom.n_shards)
         self.axis = SHARD_AXIS
         self.bank_size = bank_size
-        self.state: Optional[SpatialState] = None
-        self.tick_count = 0
         self.stats_last = np.zeros((geom.n_shards, 6), np.int32)
         self.overflow_budget = 1e-4  # alert threshold, as CombatModule
         self.overflow_alerts = 0
@@ -386,22 +373,128 @@ class SpatialWorld:
         self.auto_resize = True
         self.max_bucket_boost = 8
         self._bucket_boost = 1
-        self._step = None
-        # standalone cost ledger (the slab runs kernel-less); benches and
-        # tests read world.costbook directly
+        self._kernel: Optional[Kernel] = None
+        self._sharded: Optional[ShardedKernel] = None
+        self._mig: Optional[RowMigrationModule] = None
+        self._tick0 = 0
+        # one cost ledger across rebuilds; the kernel adopts it at build
+        # so benches and tests keep reading world.costbook
         from ..telemetry.costbook import CostBook
 
         self.costbook = CostBook()
 
+    # -- engine assembly ---------------------------------------------------
+    def _build_kernel(self, cap: int) -> None:
+        g = self.geom
+        reg = ClassRegistry()
+        reg.define(ClassDef(name="spatial", properties=[
+            prop("hp", "int"), prop("atk", "int"), prop("camp", "int"),
+            prop("gid", "int"), prop("died", "int"),
+            prop("pos", "vector2"),
+        ]))
+        k = Kernel(
+            reg,
+            store_config=StoreConfig(default_capacity=cap,
+                                     capacities={"spatial": cap}),
+            seed=0,
+        )
+        k.costbook = self.costbook
+        self._mig = RowMigrationModule(
+            g.placement(), mesh=self.mesh, order=20)
+        k.build([_SpatialModule(self), self._mig])
+        self._mig.bind(k)
+        n_sh, bank = g.n_shards, cap // g.n_shards
+        k.register_aux(VC_AUX, lambda: {
+            "pos": jnp.zeros((cap, 2), jnp.float32),
+            "active": jnp.zeros((cap,), bool),
+            "order": jnp.zeros((cap,), jnp.int32),
+            "skey": jnp.zeros((cap,), jnp.int32),
+            "slot": jnp.zeros((cap,), jnp.int32),
+            "cstat": jnp.zeros((n_sh, 3), jnp.int32),
+        })
+        k.register_aux(
+            COMBAT_STATS_AUX, lambda: jnp.zeros((n_sh, 3), jnp.int32))
+        self._kernel = k
+        self._sharded = ShardedKernel(k, mesh=self.mesh)
+
+    @property
+    def kernel(self) -> Optional[Kernel]:
+        """The unified engine underneath (None before place()/load())."""
+        return self._kernel
+
+    @property
+    def tick_count(self) -> int:
+        return self._kernel.tick_count if self._kernel else self._tick0
+
+    @tick_count.setter
+    def tick_count(self, v: int) -> None:
+        v = int(v)
+        if self._kernel is None:
+            self._tick0 = v
+            return
+        self._kernel.tick_count = v
+        self._kernel.state = self._kernel.state.replace(
+            tick=jnp.asarray(v, jnp.int32))
+
+    # -- state view (API/snapshot compatibility) ---------------------------
+    @property
+    def state(self) -> Optional[SpatialState]:
+        if self._kernel is None:
+            return None
+        self._kernel._ensure_aux()
+        cs = self._kernel.state.classes["spatial"]
+        vc = self._kernel.state.aux[VC_AUX]
+        return SpatialState(
+            pos=cs.vec[:, _POS, :2], hp=cs.i32[:, _HP],
+            atk=cs.i32[:, _ATK], camp=cs.i32[:, _CAMP],
+            gid=cs.i32[:, _GID], died=cs.i32[:, _DIED], active=cs.alive,
+            vc_pos=vc["pos"], vc_active=vc["active"], vc_order=vc["order"],
+            vc_skey=vc["skey"], vc_slot=vc["slot"], cstat=vc["cstat"],
+        )
+
+    @state.setter
+    def state(self, st: Optional[SpatialState]) -> None:
+        if st is None:
+            return
+        k = self._kernel
+        if k is None:
+            raise RuntimeError("place() or load() before assigning state")
+        k._ensure_aux()
+        cs = k.state.classes["spatial"]
+        i32 = jnp.stack(
+            [jnp.asarray(st.hp), jnp.asarray(st.atk), jnp.asarray(st.camp),
+             jnp.asarray(st.gid), jnp.asarray(st.died)], axis=1,
+        ).astype(jnp.int32)
+        pos = jnp.asarray(st.pos)
+        vec = cs.vec.at[:, _POS, 0].set(pos[:, 0]).at[:, _POS, 1].set(
+            pos[:, 1])
+        cs = cs.replace(i32=i32, vec=vec, alive=jnp.asarray(st.active))
+        new_state = with_class(k.state, "spatial", cs)
+        k.state = new_state.replace(aux={
+            **new_state.aux,
+            VC_AUX: {
+                "pos": jnp.asarray(st.vc_pos),
+                "active": jnp.asarray(st.vc_active),
+                "order": jnp.asarray(st.vc_order),
+                "skey": jnp.asarray(st.vc_skey),
+                "slot": jnp.asarray(st.vc_slot),
+                "cstat": jnp.asarray(st.cstat),
+            },
+        })
+        self._sharded.place()
+
     # -- placement --------------------------------------------------------
     def place(self, pos: np.ndarray, hp: np.ndarray, atk: np.ndarray,
               camp: np.ndarray) -> None:
-        """Distribute entities into per-shard banks by their slab.
+        """Distribute entities into per-shard bank rows by their slab.
 
         Vectorized: one stable argsort by owning shard, per-shard base
-        offsets, and a single fancy-index write per bank — the previous
-        per-entity Python loop was O(n) interpreter work at placement
-        (minutes at 1M rows)."""
+        offsets, and a single fancy-index write per bank.  Rows seed the
+        ClassState banks DIRECTLY (device-only population): per-guid
+        host allocation would be O(n) interpreter work at placement, and
+        these rows never need host identity — the host alloc_mask stays
+        all-False, so migration-vacated slots never reconcile as deaths.
+        """
         g = self.geom
         n = pos.shape[0]
         cy = np.clip((pos[:, 1] / g.cell_size).astype(np.int32), 0,
@@ -414,65 +507,43 @@ class SpatialWorld:
         if over.size:
             raise ValueError(f"bank {int(over[0])} overflow at placement")
         cap = bank * g.n_shards
-        st = SpatialState(
-            pos=np.zeros((cap, 2), np.float32),
-            hp=np.zeros((cap,), np.int32),
-            atk=np.zeros((cap,), np.int32),
-            camp=np.zeros((cap,), np.int32),
-            gid=np.full((cap,), -1, np.int32),
-            died=np.full((cap,), -1, np.int32),
-            active=np.zeros((cap,), bool),
-            vc_pos=np.zeros((cap, 2), np.float32),
-            vc_active=np.zeros((cap,), bool),
-            vc_order=np.zeros((cap,), np.int32),
-            vc_skey=np.zeros((cap,), np.int32),
-            vc_slot=np.zeros((cap,), np.int32),
-            cstat=np.zeros((g.n_shards, 3), np.int32),
-        )
+        self.bank_size = bank
+        self._build_kernel(cap)
+        i32 = np.zeros((cap, 5), np.int32)
+        i32[:, _GID] = -1
+        i32[:, _DIED] = -1
+        vec = np.zeros((cap, 1, 3), np.float32)
+        alive = np.zeros((cap,), bool)
         if n:
             order = np.argsort(owner, kind="stable")
             so = owner[order]
             starts = np.zeros(g.n_shards, np.int64)
             starts[1:] = np.cumsum(counts)[:-1]
             r = so.astype(np.int64) * bank + (np.arange(n) - starts[so])
-            st.pos[r] = pos[order, :2]
-            st.hp[r] = hp[order]
-            st.atk[r] = atk[order]
-            st.camp[r] = camp[order]
-            st.gid[r] = order
-            st.active[r] = True
-        self.bank_size = bank
-        sh = NamedSharding(self.mesh, P(self.axis))
-        self.state = SpatialState(
-            *[jax.device_put(a, sh) for a in st]
+            vec[r, 0, 0] = pos[order, 0]
+            vec[r, 0, 1] = pos[order, 1]
+            i32[r, _HP] = hp[order]
+            i32[r, _ATK] = atk[order]
+            i32[r, _CAMP] = camp[order]
+            i32[r, _GID] = order
+            alive[r] = True
+        k = self._kernel
+        cs = k.state.classes["spatial"].replace(
+            i32=jnp.asarray(i32), vec=jnp.asarray(vec),
+            alive=jnp.asarray(alive),
         )
+        k.state = with_class(k.state, "spatial", cs)
+        self._sharded.place()
 
     # -- compiled step ----------------------------------------------------
-    def _build_step(self):
-        g = self.geom
-        body = partial(_spatial_body, g, self.axis)
-        row = P(self.axis)
-        rep = P()
-        smapped = _shard_map(
-            body,
-            mesh=self.mesh,
-            in_specs=(row,) * 13 + (rep,),
-            out_specs=(row,) * 14,
-            **_SM_KW,
-        )
-        return self.costbook.wrap("spatial.step", smapped, stage="tick")
-
     def step(self, n: int = 1) -> None:
-        if self._step is None:
-            self._step = self._build_step()
-        st = self.state
+        sk = self._sharded
         for _ in range(n):
-            t = jnp.int32(self.tick_count)
-            *banks, stats = self._step(*st, t)
-            st = SpatialState(*banks)
-            self.tick_count += 1
-        self.state = st
-        self.stats_last = np.asarray(stats)
+            sk.run_device(1, fused=False)
+        aux = self._kernel.state.aux
+        mig = np.asarray(aux[self._mig.aux_key])
+        cmb = np.asarray(aux[COMBAT_STATS_AUX])
+        self.stats_last = np.concatenate([mig, cmb], axis=1)
         # runtime alerting, same contract as CombatModule's overflow
         # budget (the counters alone are bench-only visibility):
         # - mig_dropped rows left their source bank and found no free
@@ -489,9 +560,8 @@ class SpatialWorld:
             self.stats_last[:, 4:].sum()
         )
         if lost_forever or missed:
-            pop = max(1, int(np.asarray(
-                jax.jit(lambda a: a.sum())(self.state.active)
-            )))
+            alive = self._kernel.state.classes["spatial"].alive
+            pop = max(1, int(np.asarray(alive).sum()))
             if lost_forever or missed / pop > self.overflow_budget:
                 self.overflow_alerts += 1
                 import logging
@@ -516,24 +586,21 @@ class SpatialWorld:
 
     def _resize_buckets(self, drops: int, pop: int) -> None:
         """Double both cell buckets and retrace — the SpatialGeom twin of
-        CombatModule._on_overflow.  The carried Verlet cache bakes the
-        old bucket into its slot assignment, so its leaves are zeroed
-        (all-False anchor => next tick rebuilds); the lifetime counters
-        in cstat survive."""
+        CombatModule._on_overflow.  Kernel.invalidate() drops the traces
+        AND the registered aux (the carried Verlet cache bakes the old
+        bucket into its slot assignment); the lifetime counters in cstat
+        survive by being written back into the re-primed cache."""
         self._bucket_boost *= 2
         g = self.geom
         self.geom = g._replace(bucket=g.bucket * 2, att_bucket=g.att_bucket * 2)
-        self._step = None
+        k = self._kernel
+        old_cstat = k.state.aux[VC_AUX]["cstat"]
         # sanctioned retrace: the doubled buckets bake into the next trace
-        self.costbook.generation_bump("bucket_resize")
-        st = self.state
-        self.state = st._replace(
-            vc_pos=jnp.zeros_like(st.vc_pos),
-            vc_active=jnp.zeros_like(st.vc_active),
-            vc_order=jnp.zeros_like(st.vc_order),
-            vc_skey=jnp.zeros_like(st.vc_skey),
-            vc_slot=jnp.zeros_like(st.vc_slot),
-        )
+        k.invalidate()
+        k._ensure_aux()
+        vc = dict(k.state.aux[VC_AUX])
+        vc["cstat"] = old_cstat
+        k.state = k.state.replace(aux={**k.state.aux, VC_AUX: vc})
         import logging
 
         logging.getLogger("nf.spatial").warning(
@@ -550,13 +617,13 @@ class SpatialWorld:
     def rebuilds_total(self) -> int:
         """Max over shards (the pmax vote makes every shard rebuild
         together, so any shard's counter is the grid's)."""
-        if self.state is None:
+        if self._kernel is None:
             return 0
         return int(np.asarray(self.state.cstat)[:, 0].max())
 
     @property
     def reuses_total(self) -> int:
-        if self.state is None:
+        if self._kernel is None:
             return 0
         return int(np.asarray(self.state.cstat)[:, 1].max())
 
@@ -574,16 +641,19 @@ class SpatialWorld:
     # -- checkpoint / resume ----------------------------------------------
     def save(self, path: str) -> None:
         """Snapshot banks + tick counter; resuming continues the exact
-        trajectory (the walk/duty are pure functions of (gid, tick))."""
+        trajectory (the walk/duty are pure functions of (gid, tick)).
+        The npz keys are the historical slab-engine layout, so old
+        snapshots load into the unified engine and vice versa; `layout`
+        marks full-row snapshots (absent = pre-unification slab file)."""
         st = jax.tree.map(np.asarray, self.state)
         np.savez_compressed(
             path, tick=self.tick_count, bank=self.bank_size,
-            binning=binning_mode(), **st._asdict(),
+            binning=binning_mode(), layout="classrow", **st._asdict(),
         )
 
     def load(self, path: str) -> None:
         with np.load(path) as z:
-            self.tick_count = int(z["tick"])
+            tick = int(z["tick"])
             self.bank_size = int(z["bank"])
             cap = z["pos"].shape[0]
             # snapshots from before the Verlet cache carry zero caches:
@@ -598,24 +668,27 @@ class SpatialWorld:
                 "cstat": np.zeros((self.geom.n_shards, 3), np.int32),
             }
             # vc_order/vc_skey are NF_BINNING-engine-specific (sorted
-            # keys vs per-row anchor keys — VerletCache docstring); a
-            # snapshot resumed under the other engine must drop the
-            # cache or reuse-tick sub tables silently corrupt.  Old
-            # snapshots carry no marker and were written by the sort
-            # engine.
+            # keys vs per-row anchor keys — VerletCache docstring), and a
+            # pre-unification slab snapshot (no `layout` key) recorded
+            # binning but not the full-row layout this engine carries: in
+            # either mismatch the cache is dropped (all-False anchors =>
+            # first tick rebuilds; trajectories are unchanged) and only
+            # the row banks load.  Geometry is re-derived from this
+            # world's SpatialGeom + the stored bank size.
             stored = str(z["binning"]) if "binning" in z.files else "sort"
-            drop_cache = stored != binning_mode()
+            layout = str(z["layout"]) if "layout" in z.files else "slab"
+            drop_cache = stored != binning_mode() or layout != "classrow"
 
             def pick(f):
                 if f in z.files and not (drop_cache and f.startswith("vc_")):
                     return z[f]
                 return fresh[f]
 
-            sh = NamedSharding(self.mesh, P(self.axis))
+            self._build_kernel(cap)
             self.state = SpatialState(
-                *[jax.device_put(pick(f), sh)
-                  for f in SpatialState._fields]
+                *[pick(f) for f in SpatialState._fields]
             )
+            self.tick_count = tick
 
 
 def reference_step(geom: SpatialGeom, pos, hp, atk, camp, gid, died, active,

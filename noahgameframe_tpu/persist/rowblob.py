@@ -1,0 +1,192 @@
+"""Row blob: the single definition of "an entity's state" as bytes/leaves.
+
+Two consumers share this module so they can never disagree about what a
+full entity row contains:
+
+* **cross-host failover** (net/failover.py, net/roles/game.py) frames the
+  session snapshot blob with a CRC so a torn hand-off is detected before
+  ``apply_snapshot`` ever sees it, and
+* **on-mesh migration** (parallel/rowmigrate.py) derives its pack/scatter
+  list from :func:`class_row_leaf_items` — the same generic leaf walk
+  ``shard.py:world_shardings`` performs — so a newly added property bank
+  or record page can never be silently left behind when a row crosses
+  shards.
+
+``ROW_LEAF_SPEC`` below is the human-auditable contract: every
+``ClassState`` leaf path must match one of its patterns (or appear in
+``MIGRATION_EXCLUDED`` with a reason).  The ``migrate-covers-store``
+nf-lint rule cross-checks this tuple against the dataclass fields in
+core/store.py statically; :func:`class_row_leaf_items` enforces the same
+contract at runtime with a tree_leaves count assertion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import struct as _struct
+import zlib
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ..core.store import ClassState, RecordState, TimerState
+
+# -- framed session blob (failover hand-off) -------------------------------
+
+MAGIC = b"NFRB"
+VERSION = 1
+_HEADER = _struct.Struct("<4sBII")  # magic, version, payload_len, crc32
+MAX_BLOB = 64 * 1024 * 1024  # fail-closed before allocating on a bad length
+
+
+class RowBlobError(Exception):
+    """Framed row blob failed validation (torn, corrupt, wrong version)."""
+
+
+def frame_blob(payload: bytes) -> bytes:
+    """Wrap a snapshot payload in magic + version + length + CRC32."""
+    return _HEADER.pack(MAGIC, VERSION, len(payload),
+                        zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def unframe_blob(blob: bytes, allow_legacy: bool = True) -> bytes:
+    """Validate and strip the frame; raise :class:`RowBlobError` fail-closed.
+
+    ``allow_legacy=True`` passes through blobs that don't start with the
+    magic unchanged — pre-framing peers (and raw garbage) flow on to the
+    snapshot decoder, which rejects them on its own terms.  A blob that
+    DOES claim the magic must validate completely: truncation, length
+    overrun, CRC mismatch and unknown versions are all errors.
+    """
+    if not blob.startswith(MAGIC):
+        if allow_legacy:
+            return blob
+        raise RowBlobError("missing row-blob magic")
+    if len(blob) < _HEADER.size:
+        raise RowBlobError("truncated row-blob header")
+    magic, version, length, crc = _HEADER.unpack_from(blob)
+    if version != VERSION:
+        raise RowBlobError(f"unknown row-blob version {version}")
+    if length > MAX_BLOB:
+        raise RowBlobError(f"row-blob length {length} exceeds cap")
+    payload = blob[_HEADER.size:]
+    if len(payload) != length:
+        raise RowBlobError(
+            f"row-blob torn: header says {length} bytes, got {len(payload)}")
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise RowBlobError("row-blob CRC mismatch")
+    return payload
+
+
+# -- generic ClassState row-leaf walk (on-mesh migration) ------------------
+
+# Every ClassState leaf path must match one of these patterns.  The
+# migrate-covers-store lint rule checks this tuple against the store
+# dataclasses; keep it a plain literal.
+ROW_LEAF_SPEC = (
+    "i32",
+    "f32",
+    "vec",
+    "alive",
+    "timers.next_fire",
+    "timers.interval",
+    "timers.remain",
+    "timers.active",
+    "records.*.i32",
+    "records.*.f32",
+    "records.*.vec",
+    "records.*.used",
+)
+
+# Leaves waived from migration, with a reason each.  Verlet/binning
+# caches live in WorldState.aux (not ClassState) precisely so they are
+# dropped-and-rebuilt on arrival instead of migrated, so this is empty.
+MIGRATION_EXCLUDED: Tuple[str, ...] = ()
+
+
+def _covered(path: str) -> bool:
+    return any(fnmatch.fnmatch(path, pat)
+               for pat in ROW_LEAF_SPEC + MIGRATION_EXCLUDED)
+
+
+def _walk_fields(obj: Any, prefix: str, out: List[Tuple[str, Any]]) -> None:
+    for f in dataclasses.fields(type(obj)):
+        val = getattr(obj, f.name)
+        path = prefix + f.name
+        if isinstance(val, (TimerState, RecordState)):
+            _walk_fields(val, path + ".", out)
+        elif isinstance(val, dict):
+            for key in sorted(val):
+                _walk_fields(val[key], f"{path}.{key}.", out)
+        else:
+            out.append((path, val))
+
+
+def class_row_leaf_items(cs: ClassState) -> List[Tuple[str, Any]]:
+    """Ordered ``(path, array)`` pairs for every per-row leaf of ``cs``.
+
+    Guarantees — each violation raises rather than silently dropping
+    entity data during migration:
+
+    * the walk sees exactly as many leaves as ``jax.tree.leaves(cs)``
+      (a new bank added to the store cannot be missed),
+    * every path is covered by ``ROW_LEAF_SPEC``/``MIGRATION_EXCLUDED``,
+    * every leaf's leading axis is the class capacity (row-packable).
+    """
+    import jax
+
+    items: List[Tuple[str, Any]] = []
+    _walk_fields(cs, "", items)
+    n_tree = len(jax.tree_util.tree_leaves(cs))
+    if len(items) != n_tree:
+        raise RowBlobError(
+            f"row-leaf walk found {len(items)} leaves but the ClassState "
+            f"pytree has {n_tree} — a store bank is invisible to migration")
+    cap = cs.capacity
+    for path, arr in items:
+        if not _covered(path):
+            raise RowBlobError(
+                f"ClassState leaf {path!r} not covered by ROW_LEAF_SPEC — "
+                f"add it to the spec (or MIGRATION_EXCLUDED with a reason)")
+        if arr.ndim < 1 or arr.shape[0] != cap:
+            raise RowBlobError(
+                f"ClassState leaf {path!r} shape {arr.shape} has no "
+                f"capacity-leading axis; cannot pack rows")
+    return items
+
+
+def rebuild_class_state(cs: ClassState, leaves: List[Any]) -> ClassState:
+    """Inverse of :func:`class_row_leaf_items`: reassemble a ClassState
+    from replacement leaves in the same walk order."""
+    it = iter(leaves)
+
+    def rebuild(obj: Any) -> Any:
+        kw = {}
+        for f in dataclasses.fields(type(obj)):
+            val = getattr(obj, f.name)
+            if isinstance(val, (TimerState, RecordState)):
+                kw[f.name] = rebuild(val)
+            elif isinstance(val, dict):
+                kw[f.name] = {k: rebuild(val[k]) for k in sorted(val)}
+            else:
+                kw[f.name] = next(it)
+        return obj.replace(**kw)
+
+    out = rebuild(cs)
+    try:
+        next(it)
+    except StopIteration:
+        return out
+    raise RowBlobError("rebuild_class_state: more leaves than store fields")
+
+
+def row_nbytes(cs: ClassState) -> int:
+    """Bytes one migrating row carries across the mesh (all banks,
+    records, timers, alive bit) — the analytic collective-bytes unit
+    CostBook/bench attribute to the migration phase."""
+    total = 0
+    for _path, arr in class_row_leaf_items(cs):
+        per_row = int(np.prod(arr.shape[1:], dtype=np.int64)) if arr.ndim > 1 else 1
+        total += per_row * arr.dtype.itemsize
+    return total

@@ -82,35 +82,55 @@ PEAKS: Dict[str, Dict[str, Any]] = {
 }
 
 
+def _leaf_sharding(x):
+    """Hashable input-sharding component of a leaf's signature.
+
+    AOT-compiled executables are pinned to their argument shardings: the
+    same (shape, dtype) arriving replicated vs NamedSharding'd over a
+    mesh (e.g. kernel state after the first sharded-tick/migration
+    round lands it on the mesh) needs a DIFFERENT executable, and
+    handing it the cached one is a pxla ValueError, not a retrace."""
+    s = getattr(x, "sharding", None)
+    if s is None:
+        return None
+    try:
+        hash(s)
+        return s
+    except TypeError:  # pragma: no cover - exotic sharding types
+        return str(s)
+
+
 def _leaf_sig(x) -> Tuple:
     """Abstract signature of one pytree leaf — cheap on the hot path.
 
     Python scalars collapse to their type (jit retraces on a *type*
-    change, not a value change); arrays to (shape, dtype, weak_type)."""
+    change, not a value change); arrays to (shape, dtype, weak_type,
+    sharding)."""
     if x is None or isinstance(x, (bool, int, float, complex, str, bytes)):
         return ("py", type(x).__name__)
     aval = getattr(x, "aval", None)
     if aval is not None:
         return (tuple(aval.shape), str(aval.dtype),
-                bool(getattr(aval, "weak_type", False)))
+                bool(getattr(aval, "weak_type", False)),
+                str(_leaf_sharding(x)))
     shape = getattr(x, "shape", None)
     dtype = getattr(x, "dtype", None)
     if shape is not None and dtype is not None:
-        return (tuple(shape), str(dtype), False)
+        return (tuple(shape), str(dtype), False, str(None))
     return ("py", type(x).__name__)
 
 
 def _leaf_key(x):
     """Hot-path cache key for one leaf.  jax arrays key on their aval
-    object (hashable, equal iff shape/dtype/weak-type equal) so the
-    per-call cost is an attribute read instead of the shape/dtype
-    stringification `_leaf_sig` does; everything else falls back to the
-    descriptive sig.  Equal keys imply equal `_leaf_sig`s, so the
-    compile ledger and cause attribution are unchanged — only the
-    dict-lookup key is cheaper."""
+    object (hashable, equal iff shape/dtype/weak-type equal) plus their
+    committed sharding, so the per-call cost is two attribute reads
+    instead of the shape/dtype stringification `_leaf_sig` does;
+    everything else falls back to the descriptive sig.  Equal keys imply
+    equal `_leaf_sig`s, so the compile ledger and cause attribution are
+    unchanged — only the dict-lookup key is cheaper."""
     aval = getattr(x, "aval", None)
     if aval is not None:
-        return aval
+        return (aval, _leaf_sharding(x))
     return _leaf_sig(x)
 
 
@@ -163,7 +183,9 @@ class CostEntry:
                 return f"shape:{p}"
             if a[1] != b[1]:
                 return f"dtype:{p}"
-            return f"weak-type:{p}"
+            if a[2] != b[2]:
+                return f"weak-type:{p}"
+            return f"sharding:{p}"
         # identical signature: a fresh dispatcher re-wrapped the entry —
         # the retrace is about traced CONSTANTS (invalidate/set_phases
         # close over new tables), not about the arguments

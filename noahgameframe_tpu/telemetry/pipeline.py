@@ -137,8 +137,8 @@ class StageClock:
     :attr:`last_wall_ns` exactly) and feeds per-stage histograms.
     """
 
-    STAGES: Tuple[str, ...] = ("tick", "harvest", "interest", "encode",
-                               "assemble", "send", "other")
+    STAGES: Tuple[str, ...] = ("tick", "migrate", "harvest", "interest",
+                               "encode", "assemble", "send", "other")
 
     def __init__(self, registry=None, window: int = 512):
         self._acc: Dict[str, int] = {}

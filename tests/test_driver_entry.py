@@ -47,6 +47,30 @@ def test_bench_served_smoke():
     assert d["detail"]["sync_msgs"] > 0  # fan-out actually happened
 
 
+def test_bench_mesh_migrate_smoke():
+    """The r09 unified-engine ladder at toy scale: full-row migration
+    actually moves rows, drops nothing, and the post-warmup sweep loop
+    compiles nothing new (the zero-unexplained-recompiles gate)."""
+    r = _run(
+        ["bench.py", "--mesh-migrate", "4", "--mig-entities", "4096",
+         "--mig-widths", "2,4", "--mig-budgets", "64", "--mig-ticks", "3"],
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    d = json.loads(r.stdout.strip().splitlines()[-1])
+    assert d["metric"] == "mesh_migrate_entity_ticks_per_sec"
+    assert "error" not in d, d.get("error")
+    assert d["value"] > 0
+    assert d["detail"]["unexplained_recompiles"] == 0
+    pts = d["detail"]["points"]
+    assert len(pts) == 2  # 1 entity count x 2 widths x 1 budget
+    for p in pts:
+        assert p["migrated_total"] > 0, "ladder exercised no migration"
+        assert p["mig_dropped_total"] == 0
+        assert p["row_bytes"] > 0
+        assert p["costbook"]["compiles"] >= 1
+
+
 def test_dryrun_multichip_forces_cpu_and_finishes():
     r = _run(["__graft_entry__.py", "multichip", "4"], timeout=180)
     assert r.returncode == 0, r.stderr[-2000:]
