@@ -697,6 +697,181 @@ def run_mesh_migrate(args) -> dict:
     }
 
 
+def run_reshard(args) -> dict:
+    """ISSUE 17 r10 evidence: the elastic reshard ladder.  Each point
+    builds a lean migrating world on a 2-device mesh, grows it to 4 and
+    drains back to 3 under continuous motion churn, and reports the
+    reshard costs the live serving path pays: rebalance/exodus ticks,
+    wall time per op (retrace included), rows moved, analytic collective
+    bytes (full ClassState row x rows moved), and the same CostBook gate
+    as the migration ladder — after the warmup mark, every recompile
+    must be generation-sanctioned (``unexplained_recompiles == 0``)."""
+    from noahgameframe_tpu.utils.platform import force_cpu
+
+    # NO persistent compile cache here, deliberately: jaxlib 0.4.37's
+    # CPU client segfaults (heap corruption) deserializing a CACHE HIT
+    # of the exodus-armed drain executable — cold compiles run fine,
+    # the second process to hit the entry dies at dispatch.  The
+    # ladder's compiles are single-step and cheap, so skipping
+    # init_compile_cache() costs seconds and removes the landmine.
+    if args.platform == "tpu":
+        # chip-native: the ladder runs over the first 4 real devices
+        # (grow targets a 4-wide mesh); the harvest queue guards on the
+        # backend actually exposing them
+        import jax
+
+        if len(jax.devices()) < 4:
+            raise RuntimeError(
+                f"--reshard --platform tpu needs >=4 devices, backend "
+                f"exposes {len(jax.devices())}")
+    else:
+        jax = force_cpu(args.reshard)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from noahgameframe_tpu.core.schema import ClassDef, ClassRegistry, prop, record
+    from noahgameframe_tpu.core.store import StoreConfig, with_class
+    from noahgameframe_tpu.kernel.kernel import Kernel
+    from noahgameframe_tpu.kernel.module import Module
+    from noahgameframe_tpu.parallel.elastic import ElasticMesh
+    from noahgameframe_tpu.parallel.mesh import make_mesh
+    from noahgameframe_tpu.parallel.rowmigrate import (
+        RowMigrationModule,
+        SpatialPlacement,
+    )
+    from noahgameframe_tpu.parallel.shard import ShardedKernel
+
+    extent = 256.0
+
+    class _Drift(Module):
+        name = "drift"
+
+        def __init__(self):
+            super().__init__()
+            self.add_phase("move", self._move, order=10)
+
+        def _move(self, state, ctx):
+            cs = state.classes["Npc"]
+            y = jnp.mod(cs.vec[:, 0, 1] + 1.5, extent)
+            return with_class(state, "Npc",
+                              cs.replace(vec=cs.vec.at[:, 0, 1].set(y)))
+
+    # capacities must split at every width visited (2, 4 and the
+    # post-drain 3) — LCM 12
+    caps = [int(x) for x in (args.mig_entities or "12000,60000").split(",")]
+    budgets = [int(x) for x in (args.mig_budgets or "512,2048").split(",")]
+
+    def point(cap, budget):
+        if cap % 12:
+            raise ValueError(f"--reshard capacities must divide by 12 "
+                             f"(widths 2/4/3 are visited), got {cap}")
+        reg = ClassRegistry()
+        reg.define(ClassDef(name="Npc", properties=[
+            prop("Id", "int"), prop("HP", "int"), prop("Position", "vector2"),
+        ], records=[
+            record("Bag", 3, [("item", "int"), ("weight", "float")]),
+        ]))
+        k = Kernel(reg, store_config=StoreConfig(
+            default_capacity=cap, capacities={"Npc": cap},
+            timer_slots={"Npc": 2},
+        ), seed=args.seed)
+        mesh = make_mesh(2)
+        mig = RowMigrationModule(SpatialPlacement(
+            class_name="Npc", pos_prop="Position", extent=extent,
+            cell_size=8.0, width=32, n_shards=2, mig_budget=budget,
+        ), mesh=mesh, order=20)
+        k.build([_Drift(), mig])
+        mig.bind(k)
+
+        live = cap // 2
+        rng = np.random.default_rng(args.seed)
+        i32 = np.zeros((cap, 2), np.int32)
+        i32[:, 0] = np.arange(cap)
+        i32[:live, 1] = 100
+        vec = np.zeros((cap, 1, 3), np.float32)
+        vec[:live, 0, 0] = rng.uniform(1.0, extent - 1, live)
+        vec[:live, 0, 1] = rng.uniform(1.0, extent - 1, live)
+        alive = np.zeros(cap, bool)
+        alive[:live] = True
+        cs = k.state.classes["Npc"].replace(
+            i32=jnp.asarray(i32), vec=jnp.asarray(vec),
+            alive=jnp.asarray(alive))
+        k.state = with_class(k.state, "Npc", cs)
+
+        sk = ShardedKernel(k, mesh=mesh)
+        sk.place()
+        el = ElasticMesh(sk, migration=mig, ident_cols={"Npc": 0},
+                         exodus_tick_bound=512)
+        sk.run_device(2, fused=False)  # compile + warm at width 2
+        mark = k.costbook.mark()
+
+        def drive(begin):
+            t0 = time.perf_counter()
+            begin()
+            for _ in range(600):
+                el.poll()
+                if el.inflight is None:
+                    break
+                sk.run_device(1, fused=False)
+            assert el.inflight is None, "reshard op never settled"
+            return time.perf_counter() - t0, el.ops_done[-1]
+
+        grow_s, grow = drive(lambda: el.begin_grow(4))
+        drain_s, drain = drive(lambda: el.begin_drain(1))
+        unexplained = k.costbook.unexplained_since(mark)
+        row_b = mig.row_bytes()
+        moved = int(el.rows_moved_total)
+        return {
+            "capacity": cap,
+            "live": live,
+            "mig_budget": budget,
+            "grow_wall_s": round(grow_s, 2),
+            "grow_rebalance_ticks": int(grow["rebalance_ticks"]),
+            "drain_wall_s": round(drain_s, 2),
+            "drain_exodus_ticks": int(drain["exodus_ticks"]),
+            "drained_in_budget": bool(drain["drained_in_budget"]),
+            "pop_conserved": all(
+                op["pop_after"] == op["pop_before"] == live
+                for op in (grow, drain)),
+            "rows_moved_total": moved,
+            "dropped_rows": int(el.dropped_rows),
+            "row_bytes": row_b,
+            # analytic wire cost: every re-homed row ships its FULL
+            # ClassState (banks + records + timers + alive) once
+            "reshard_collective_bytes": row_b * moved,
+            "unexplained_recompiles": len(unexplained),
+            "costbook": _costbook_detail(k.costbook),
+        }
+
+    points = []
+    for cap in caps:
+        for budget in budgets:
+            # full product at the smallest capacity ranks the budget
+            # knob; larger rungs run the headline config only
+            if cap != caps[0] and budget != budgets[-1]:
+                continue
+            points.append(point(cap, budget))
+    head = points[-1]
+    return {
+        "metric": "reshard_drain_exodus_ticks",
+        "value": head["drain_exodus_ticks"],
+        "unit": "ticks",
+        "detail": {
+            "devices": args.reshard,
+            "seed": args.seed,
+            "platform": jax.devices()[0].platform,
+            "widths_visited": [2, 4, 3],
+            "all_gates": all(
+                p["pop_conserved"] and p["dropped_rows"] == 0
+                and p["unexplained_recompiles"] == 0 for p in points),
+            "unexplained_recompiles": sum(p["unexplained_recompiles"]
+                                          for p in points),
+            "points": points,
+        },
+    }
+
+
 def run_bench(args) -> dict:
     import jax
 
@@ -1124,6 +1299,15 @@ def main() -> None:
              "CostBook zero-unexplained-recompile gate (r09 evidence)",
     )
     ap.add_argument(
+        "--reshard", type=int, default=0, metavar="N",
+        help="elastic reshard ladder over N virtual CPU devices (needs "
+             ">=4; with --platform tpu, over the first 4 real chips): "
+             "grow 2->4 then drain->3 under motion churn, reporting "
+             "rebalance/exodus ticks, reshard collective bytes and the "
+             "zero-unexplained-recompile gate (r10 evidence); capacity/"
+             "budget knobs reuse --mig-entities/--mig-budgets",
+    )
+    ap.add_argument(
         "--mig-entities", default=None, metavar="N,N,...",
         help="mesh-migrate entity ladder (default 100000,1000000; the "
              "full knob product runs at the smallest count only)",
@@ -1165,6 +1349,38 @@ def main() -> None:
         if args.ticks is None:
             args.ticks = 8
         _emit(_run_session_sweep(args))
+        return
+
+    if args.reshard:
+        if args.platform != "tpu" and args.reshard < 4:
+            _emit(
+                {
+                    "metric": "reshard_drain_exodus_ticks",
+                    "value": 0,
+                    "unit": "ticks",
+                    "error": "--reshard runs on N>=4 virtual CPU devices "
+                             "or real chips via --platform tpu (the "
+                             "ladder grows to a 4-wide mesh)",
+                }
+            )
+            return
+        try:
+            _emit(run_reshard(args))
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            _emit(
+                {
+                    "metric": "reshard_drain_exodus_ticks",
+                    "value": 0,
+                    "unit": "ticks",
+                    "error": f"{type(e).__name__}: {e}",
+                    "detail": {
+                        "trace_tail": traceback.format_exc().strip()
+                        .splitlines()[-4:],
+                    },
+                }
+            )
         return
 
     if args.mesh_migrate:

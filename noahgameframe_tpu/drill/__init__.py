@@ -38,6 +38,7 @@ from .invariants import (
     MonotoneWatermarks,
     NoSilentDrop,
     OrderedReplay,
+    StableUnderReshard,
     default_invariants,
 )
 from .report import DrillReport, Violation
@@ -56,6 +57,7 @@ __all__ = [
     "MonotoneWatermarks",
     "NoSilentDrop",
     "OrderedReplay",
+    "StableUnderReshard",
     "Step",
     "Violation",
     "default_invariants",

@@ -306,6 +306,75 @@ class ConsistentCounters(Invariant):
         return out
 
 
+class StableUnderReshard(Invariant):
+    """The world is bit-stable through mesh topology changes (ISSUE 17).
+
+    Four clauses, sampled over every game role exposing an ``elastic``
+    driver (read defensively — non-elastic games are skipped):
+
+    1. **zero dropped rows** — the migrate protocol's drop counter and
+       the reshard ledger must both stay at 0, ever;
+    2. **population conserved** — after every completed grow/drain the
+       migrating class's live count equals the op's baseline (budget
+       overflow strands rows, it never destroys them);
+    3. **bounded exodus lag** — a drain's pre-copy empties the evicted
+       device's row range within ``exodus_tick_bound`` ticks;
+    4. **digest parity** — when a :class:`~..parallel.elastic.
+       DigestControl` is given, the live world's placement-invariant
+       ``canonical_digest`` equals the single-shard fault-free control
+       advanced to the same tick — the mesh may have grown, drained and
+       rebalanced in between, the bytes may not differ.
+    """
+
+    name = "stable_under_reshard"
+
+    def __init__(self, control=None, digest_every: int = 1) -> None:
+        self.control = control
+        self.digest_every = max(1, int(digest_every))
+        self._digest_checks = 0
+        self._last_digest_tick = -1
+
+    def check(self, ctx: DrillContext) -> List[str]:
+        out: List[str] = []
+        for game in list(getattr(ctx.cluster, "games", ())):
+            el = getattr(game, "elastic", None)
+            if el is None:
+                continue
+            st = el.status()
+            name = getattr(getattr(game, "config", None), "name", "game")
+            if int(st.get("dropped_rows", 0)):
+                out.append(f"{name}: reshard dropped "
+                           f"{st['dropped_rows']} row(s)")
+            inflight = st.get("inflight")
+            if inflight is None and int(st.get("resharded_total", 0)):
+                pop, base = int(st.get("pop", 0)), int(
+                    st.get("pop_baseline", 0))
+                if pop != base:
+                    out.append(f"{name}: population not conserved across "
+                               f"reshard: {base} -> {pop}")
+            bound = int(st.get("exodus_tick_bound", 0))
+            lag = int(st.get("exodus_ticks", 0))
+            if inflight == "drain" and bound and lag > bound:
+                out.append(f"{name}: exodus lag {lag} ticks exceeds "
+                           f"bound {bound}")
+            if self.control is not None:
+                tick = int(getattr(getattr(game, "kernel", None),
+                                   "tick_count", 0))
+                if (tick > self._last_digest_tick
+                        and tick >= self.control.tick_count
+                        and tick % self.digest_every == 0):
+                    self._last_digest_tick = tick
+                    self._digest_checks += 1
+                    live = el.digest()
+                    want = self.control.advance_to(tick)
+                    if live is not None and live != want:
+                        out.append(
+                            f"{name}: canonical digest diverged from "
+                            f"static-mesh control at tick {tick}: "
+                            f"{live:#x} != {want:#x}")
+        return out
+
+
 def default_invariants(
     store_probe: Optional[Callable[[], Dict[str, Tuple[int, int]]]] = None,
     lag_slack_s: float = 1.0,

@@ -137,6 +137,14 @@ class DrillRunner:
             role = next(r for r in cluster.roles
                         if r.config.name == kw["role"])
             role.checkpoint_now()
+        elif step.action == "grow_mesh":
+            role = next(r for r in cluster.roles
+                        if r.config.name == kw["role"])
+            role.grow_mesh(int(kw["n"]))
+        elif step.action == "drain_device":
+            role = next(r for r in cluster.roles
+                        if r.config.name == kw["role"])
+            role.drain_device(int(kw["device"]))
         elif step.action == "call":
             kw["fn"](self)
         # "note" is a pure marker — the fired log below is its effect

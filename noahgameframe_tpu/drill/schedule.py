@@ -17,6 +17,9 @@ Built-in actions (resolved by the runner against its cluster):
 ``store_faults``   ``pattern=, faults=StoreFaults(...)`` → live re-arm
 ``link_faults``    ``pattern=, faults=LinkFaults(...)`` → live re-arm
 ``checkpoint``     ``role=<config name>`` → ``role.checkpoint_now()``
+``grow_mesh``      ``role=<config name>, n=<devices>`` → ``role.grow_mesh``
+``drain_device``   ``role=<config name>, device=<index>`` →
+                   ``role.drain_device``
 ``call``           ``fn=<callable(runner)>`` — surge traffic, asserts, …
 ``note``           no-op marker; lands in the report's action log
 =================  ====================================================
@@ -40,6 +43,8 @@ BUILTIN_ACTIONS = (
     "store_faults",
     "link_faults",
     "checkpoint",
+    "grow_mesh",
+    "drain_device",
     "call",
     "note",
 )

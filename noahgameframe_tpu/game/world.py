@@ -167,6 +167,11 @@ class GameWorld:
         self.telemetry = TelemetryModule()
         modules.append(self.telemetry)
 
+        # elastic mesh surface (parallel/elastic.py): populated by
+        # .shard(); None keeps the world single-device
+        self.sharded = None
+        self.elastic = None
+
         self._rng = np.random.default_rng(cfg.seed)
         self.pm = PluginManager(app_name="game")
         self.pm.register_plugin(Plugin("KernelPlugin", [self.kernel]))
@@ -186,6 +191,41 @@ class GameWorld:
         guilds, mail, ranks, buff defs) survives a resume."""
         return list(self.pm.modules.values())
 
+    def shard(self, n_devices: Optional[int] = None, mesh=None,
+              ident_cols: Optional[Dict[str, int]] = None,
+              exodus_tick_bound: int = 256, autoscaler=None):
+        """Place the built world onto a device mesh and attach the
+        elastic grow/drain driver.  With a config placement attached,
+        the mesh defaults to the migration module's (they must agree —
+        the migrate phase shard_maps over the same device set the state
+        lives on); an explicit different width retargets the placement.
+        Returns the :class:`~..parallel.elastic.ElasticMesh`."""
+        import dataclasses as _dc
+
+        from ..parallel.elastic import ElasticMesh
+        from ..parallel.mesh import make_mesh
+        from ..parallel.shard import ShardedKernel
+
+        if mesh is None:
+            if n_devices is None and self.migration is not None:
+                mesh = self.migration.mesh
+            else:
+                mesh = make_mesh(n_devices)
+        if self.migration is not None and mesh is not self.migration.mesh:
+            self.migration.retarget(
+                placement=_dc.replace(self.migration.placement,
+                                      n_shards=int(mesh.devices.size)),
+                mesh=mesh,
+            )
+        self.sharded = ShardedKernel(self.kernel, mesh=mesh)
+        self.sharded.place()
+        self.elastic = ElasticMesh(
+            self.sharded, migration=self.migration,
+            registry=self.telemetry.registry, ident_cols=ident_cols,
+            exodus_tick_bound=exodus_tick_bound, autoscaler=autoscaler,
+        )
+        return self.elastic
+
     def save(self, path) -> None:
         from ..persist.checkpoint import save_world
 
@@ -195,6 +235,12 @@ class GameWorld:
         from ..persist.checkpoint import load_world
 
         load_world(self.kernel, path, modules=self.all_modules)
+        if self.sharded is not None:
+            # cross-engine restore: the snapshot may come from a mesh of
+            # any width (load_world leaves arrays uncommitted on the
+            # default device) — drop every trace/cache and re-place the
+            # restored state through world_shardings on the CURRENT mesh
+            self.sharded.reshard(cause="snapshot_load")
 
     # -- seeding --------------------------------------------------------------
 

@@ -135,6 +135,14 @@ class TickCtx:
         slot = self.kernel.schedule.slot(class_name, timer_name)
         return self._fired[class_name][:, slot]
 
+    def remap_fired(self, class_name: str, fired: jnp.ndarray) -> None:
+        """Republish a class's [C, T] fired mask after a phase permuted its
+        rows.  The schedule computes fired masks BEFORE phases run, so a
+        phase that moves rows (cross-shard migration) must move the mask
+        with them — otherwise a row that migrates mid-tick leaves its fire
+        behind on a now-dead slot and later handlers silently skip it."""
+        self._fired[class_name] = fired
+
     def rng(self) -> jnp.ndarray:
         """A fresh PRNG key (deterministic per tick + call position)."""
         self._rng_count += 1

@@ -34,6 +34,7 @@ from .rules_contracts import (
     PumpSurfaceRule,
 )
 from .rules_determinism import UnseededRngRule, WallClockRule
+from .rules_mesh import MeshNotCapturedRule
 from .rules_serving import ServeLoopRule
 from .rules_store import MigrateCoversStoreRule
 from .rules_trace import RecompileHazardRule, TraceSafetyRule
@@ -53,6 +54,7 @@ ALL_RULES = (
     DispatchHandlerRule,
     ServeLoopRule,
     MigrateCoversStoreRule,
+    MeshNotCapturedRule,
 )
 
 RULES_BY_NAME = {cls.name: cls for cls in ALL_RULES}

@@ -1,0 +1,69 @@
+"""Elastic-mesh rule: traced code must not capture a mesh via object state.
+
+A ``jax.sharding.Mesh`` names physical devices.  Code that runs inside
+the compiled tick bakes whatever mesh it read at trace time into the
+executable — so a mesh reached through mutable object state
+(``self.mesh``) silently pins the OLD device set after an elastic
+reshard (``ShardedKernel.reshard`` / ``ElasticMesh``) unless the holder
+is re-aimed and the kernel invalidated in the same breath.  Passing the
+mesh as a function parameter keeps the dependency visible at every
+call site and re-binds naturally on the post-reshard retrace.
+
+The rule walks the jit-reachable call graph (same roots as
+trace-safety: jit sites + ``add_phase`` registrations) and flags
+``self.<attr>`` reads where the attribute is mesh-named (``mesh`` or
+``*_mesh``).  A read that genuinely participates in the reshard
+contract — retarget() + invalidate() before every retrace — carries a
+same-line ``nf-lint: disable=mesh-not-captured -- <why>`` waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .callgraph import traced_reachable
+from .engine import Finding, PackageContext, Rule
+from .rules_trace import _TracedScan
+
+_MESH_ATTRS = ("mesh",)
+
+
+def _mesh_named(attr: str) -> bool:
+    return attr in _MESH_ATTRS or attr.endswith("_mesh")
+
+
+class _MeshScan(_TracedScan):
+    def visit_Attribute(self, node):
+        if isinstance(node.ctx, ast.Load) and _mesh_named(node.attr) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            self.rule.flag(
+                node,
+                f"`self.{node.attr}` read {self.where()} — a mesh "
+                "captured through object state pins the trace to a stale "
+                "device set after an elastic reshard; pass the mesh as a "
+                "parameter (or retarget()+invalidate() and waive with a "
+                "reason)",
+                path=self.tf.info.rel)
+        self.generic_visit(node)
+
+
+class MeshNotCapturedRule(Rule):
+    """Stale-device-set hazard: mesh reads through `self` in traced code."""
+
+    name = "mesh-not-captured"
+    description = (
+        "jit-reachable code must not read a mesh via object state "
+        "(`self.mesh`); pass it as a parameter so an elastic reshard "
+        "re-binds it on the retrace.")
+    per_module = False
+
+    def run_package(self, ctx: PackageContext) -> List[Finding]:
+        self.findings = []
+        for tf in traced_reachable(ctx).values():
+            if tf.info.rel not in ctx.modules:
+                continue
+            self.module = ctx.modules[tf.info.rel]
+            _MeshScan(self, tf).scan()
+        return self.findings

@@ -295,6 +295,10 @@ class GameRole(ServerRole):
         self._ckpt_counter = reg.counter(
             "nf_checkpoints_total", "atomic world checkpoints written"
         )
+        self._reshard_resets = reg.counter(
+            "nf_reshard_view_resets_total",
+            "session views force-reset because a reshard moved their rows"
+        )
         self._recover_counter = reg.counter(
             "nf_recoveries_total", "world restores from checkpoint (resume)"
         )
@@ -1866,6 +1870,14 @@ class GameRole(ServerRole):
                     self.kernel.tick()
                 pm.frame += 1
                 self._tick_hist.observe(_time.perf_counter() - t0)
+            if self.elastic is not None:
+                # advance any in-flight grow/drain; when one completes,
+                # force-reset exactly the sessions whose seen-state
+                # intersects the rows the reshard actually moved
+                with sc.stage("reshard"):
+                    moved = self.elastic.poll()
+                if moved:
+                    self._reset_views_for_moved(moved)
             if self.journal is not None:
                 # closes this tick's input window; the digest rode the
                 # summary fetch the tick already paid for
@@ -1924,6 +1936,58 @@ class GameRole(ServerRole):
                 and now - self._last_checkpoint >= self.checkpoint_seconds):
             self._last_checkpoint = now
             self.checkpoint_now()
+
+    # ------------------------------------------------------- elastic mesh
+    @property
+    def elastic(self):
+        """The world's grow/drain driver (parallel/elastic.py), or None
+        for a single-device world.  Read through the world each time so
+        a revive that swaps the world swaps the driver with it."""
+        return getattr(self.game_world, "elastic", None)
+
+    def grow_mesh(self, n_devices: int) -> None:
+        """Expand the serving mesh; the reshard and spatial rebalance
+        run inside subsequent ticks' ``reshard`` stage."""
+        el = self.elastic
+        if el is None:
+            raise RuntimeError(f"{self.config.name}: world is not sharded")
+        el.begin_grow(int(n_devices))
+
+    def drain_device(self, device_index: int) -> None:
+        """Evict one mesh device via the budgeted row exodus, then
+        shrink — driven tick-by-tick from the ``reshard`` stage."""
+        el = self.elastic
+        if el is None:
+            raise RuntimeError(f"{self.config.name}: world is not sharded")
+        el.begin_drain(int(device_index))
+
+    def _reset_views_for_moved(self, moved: Dict[str, np.ndarray]) -> None:
+        """Force reset_view for sessions whose seen-state references rows
+        a completed reshard moved — and ONLY those.  The batched engine
+        intersects per-slot SeenTable rows exactly; the legacy engine's
+        per-session seen dicts carry no row index, so it conservatively
+        resets every session with a non-empty mirror."""
+        from ..serving import sessions_seeing_rows
+
+        affected = set()
+        for cname, rows in moved.items():
+            if len(rows) == 0:
+                continue
+            if self.serve_batch:
+                affected.update(
+                    sessions_seeing_rows(self._session_table, cname, rows))
+            else:
+                affected.update(
+                    k for k, s in self.sessions.items()
+                    if getattr(s, "_interest_seen", None))
+        count = 0
+        for key in affected:
+            sess = self.sessions.get(key)
+            if sess is not None:
+                self.reset_view(sess)
+                count += 1
+        if count:
+            self._reshard_resets.inc(count)
 
     def checkpoint_now(self):
         """Write one atomic whole-world checkpoint; returns its path."""

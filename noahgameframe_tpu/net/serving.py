@@ -160,6 +160,31 @@ class SessionTable:
         self.seen[cname] = tbl
 
 
+def sessions_seeing_rows(
+    table: SessionTable, cname: str, rows
+) -> List[Hashable]:
+    """Session keys whose device seen-state for ``cname`` references any
+    of ``rows`` — the exact force-``reset_view`` set after an elastic
+    reshard moved those entity rows (parallel/elastic.py): a seen-row
+    now describing a different entity would silently diff against the
+    wrong baseline, while every other session's mirror is still valid
+    and must NOT pay a full resend."""
+    from ..ops.serving import SENTINEL
+
+    tbl = table.seen.get(cname)
+    moved = np.asarray(rows)
+    # empty seen slots are SENTINEL-padded — a SENTINEL in `rows` would
+    # otherwise mark every session as affected
+    moved = moved[moved != SENTINEL]
+    if tbl is None or moved.size == 0:
+        return []
+    hit = np.isin(np.asarray(tbl.rows), moved).any(axis=1)
+    return [
+        key for key, slot in table.slot_of.items()
+        if slot < hit.shape[0] and bool(hit[slot])
+    ]
+
+
 def segments(
     counts: np.ndarray, item_bytes: int, payload: bytes
 ) -> Tuple[np.ndarray, bytes]:

@@ -5,6 +5,7 @@ group partitioning, World-server cross-shard relay — SURVEY §2.4, §5) maps
 here to jax.sharding over ICI/DCN.
 """
 
+from .elastic import Autoscaler, AutoscalePolicy, DigestControl, ElasticMesh
 from .mesh import SHARD_AXIS, make_mesh, replicated, row_sharding
 from .multihost import (
     DistRendezvous,
@@ -24,7 +25,11 @@ from .shard import ShardedKernel, shard_rows_by_cell, world_shardings
 from .spatial import SpatialGeom, SpatialState, SpatialWorld
 
 __all__ = [
+    "Autoscaler",
+    "AutoscalePolicy",
+    "DigestControl",
     "DistRendezvous",
+    "ElasticMesh",
     "RowMigrationModule",
     "SpatialPlacement",
     "canonical_digest",

@@ -145,7 +145,8 @@ def mesh_migrate_class(
     owner_fn: Callable,
     budget: int,
     axis: str = SHARD_AXIS,
-) -> Tuple[ClassState, jnp.ndarray]:
+    extra_leaves: Optional[Sequence[jnp.ndarray]] = None,
+):
     """Migrate full ClassState rows toward their owning shard.
 
     ``owner_fn({path: local_leaf}) -> [rows] i32`` maps the shard-local
@@ -154,20 +155,31 @@ def mesh_migrate_class(
     own occupancy bookkeeping; every other leaf rides the generic
     pack/scatter.  Returns (new ClassState, [n_shards, 3] i32 stats:
     migrated / budget-overflow / dropped per shard).
+
+    ``extra_leaves`` are additional per-row arrays (leading axis = class
+    capacity, row-sharded like the banks) that migrate WITH the row but
+    live outside ClassState — e.g. the tick's in-flight fired mask, which
+    the schedule computed before this phase and later phases still read.
+    They ride the same pack/ppermute/scatter and are returned as a third
+    element, permuted consistently with the class state.
     """
     n = mesh.devices.size
     items = class_row_leaf_items(cs)
     paths = [p for p, _ in items]
     arrs = [a for _, a in items]
     ai = paths.index("alive")
+    extras = list(extra_leaves) if extra_leaves else []
+    n_row = len(arrs)
     row = P(axis)
 
     def body(*local):
         local = list(local)
-        alive = local[ai]
-        others = local[:ai] + local[ai + 1:]
+        row_local, extras_local = local[:n_row], local[n_row:]
+        alive = row_local[ai]
+        others = row_local[:ai] + row_local[ai + 1:] + extras_local
 
         def owner_of(ls, alv):
+            # extras sit past the named paths; owner_fn never sees them
             full: Dict[str, jnp.ndarray] = {}
             j = 0
             for p in paths:
@@ -190,18 +202,23 @@ def mesh_migrate_class(
                 merged.append(new_others[j])
                 j += 1
         stats = jnp.stack([mig, ovf, drp])[None, :]  # [1, 3] per shard
-        return tuple(merged) + (stats,)
+        return tuple(merged) + tuple(new_others[n_row - 1:]) + (stats,)
 
     smapped = _shard_map(
         body,
         mesh=mesh,
-        in_specs=(row,) * len(arrs),
-        out_specs=(row,) * (len(arrs) + 1),
+        in_specs=(row,) * (n_row + len(extras)),
+        out_specs=(row,) * (n_row + len(extras) + 1),
         **_SM_KW,
     )
-    out = smapped(*arrs)
-    new_leaves, stats = list(out[:-1]), out[-1]
-    return rebuild_class_state(cs, new_leaves), stats
+    out = smapped(*(arrs + extras))
+    new_leaves = list(out[:n_row])
+    new_extras = list(out[n_row:-1])
+    stats = out[-1]
+    new_cs = rebuild_class_state(cs, new_leaves)
+    if extra_leaves is None:
+        return new_cs, stats
+    return new_cs, stats, new_extras
 
 
 # -- GameWorld-facing placement config ------------------------------------
@@ -218,12 +235,15 @@ class SpatialPlacement:
     extent: float            # world is [0, extent)^2
     cell_size: float
     width: int               # cells per axis
-    n_shards: int            # horizontal slabs; width % n_shards == 0
+    n_shards: int            # horizontal slabs
     mig_budget: int          # migrant rows per direction per shard per tick
 
     @property
     def slab_h(self) -> int:
-        return self.width // self.n_shards
+        # ceil division: when width % n_shards != 0 (an elastic drain to
+        # an odd survivor count) the LAST shard owns a narrower slab but
+        # owner_of_pos stays in [0, n_shards) for every cell
+        return -(-self.width // self.n_shards)
 
     def owner_of_pos(self, pos_xy: jnp.ndarray) -> jnp.ndarray:
         """[rows, 2+] positions -> [rows] i32 owning shard index."""
@@ -252,6 +272,11 @@ class RowMigrationModule(Module):
         self.placement = placement
         self.mesh = mesh if mesh is not None else make_mesh(placement.n_shards)
         self.aux_key = f"rowmigrate.{placement.class_name}.stats"
+        # exodus overlay (parallel/elastic.py drain protocol): when set,
+        # owners are remapped through a host table so rows vacate a
+        # draining shard; both are trace-time constants, so arming or
+        # clearing REQUIRES kernel.invalidate() (set_exodus does it)
+        self._exodus_map: Optional[jnp.ndarray] = None
         self.add_phase("migrate", self._migrate, order=order)
 
     def bind(self, kernel) -> None:
@@ -262,6 +287,39 @@ class RowMigrationModule(Module):
         kernel.register_aux(
             self.aux_key, lambda: jnp.zeros((n, 3), jnp.int32)
         )
+
+    def retarget(self, placement: Optional[SpatialPlacement] = None,
+                 mesh: Optional[Mesh] = None) -> None:
+        """Re-aim the migrate phase at a new placement and/or mesh — the
+        elastic reshard path.  The stats aux re-registers at the new
+        shard count; the caller must invalidate + re-place (ElasticMesh
+        does both via ShardedKernel.reshard, which drops the old aux and
+        primes the new shape before the next trace)."""
+        if placement is not None:
+            if placement.class_name != self.placement.class_name:
+                raise ValueError("retarget cannot change the migrating "
+                                 "class (aux key is class-keyed)")
+            self.placement = placement
+        if mesh is not None:
+            self.mesh = mesh
+        if self.kernel is not None:
+            self.bind(self.kernel)
+
+    def set_exodus(self, index_map) -> None:
+        """Arm the drain overlay: spatial owner ``o`` is remapped to
+        ``index_map[o]`` so every row owned by a draining shard re-homes
+        to a surviving one.  Bumps the kernel trace generation — the
+        remap is a traced constant."""
+        self._exodus_map = jnp.asarray(index_map, jnp.int32)
+        if self.kernel is not None:
+            self.kernel.invalidate()
+
+    def clear_exodus(self) -> None:
+        if self._exodus_map is None:
+            return
+        self._exodus_map = None
+        if self.kernel is not None:
+            self.kernel.invalidate()
 
     def after_init(self) -> None:
         if self.kernel is not None and self.aux_key not in getattr(
@@ -277,16 +335,52 @@ class RowMigrationModule(Module):
 
     def _migrate(self, state: WorldState, ctx) -> WorldState:
         pl = self.placement
+        exodus = self._exodus_map
         cs = state.classes[pl.class_name]
         slot = ctx.store.spec(pl.class_name).slot(pl.pos_prop)
 
         def owner_fn(leaves: Dict[str, jnp.ndarray]) -> jnp.ndarray:
             pos = leaves["vec"][:, slot.col, :]
-            return pl.owner_of_pos(pos)
+            owner = pl.owner_of_pos(pos)
+            if exodus is not None:
+                # runs inside mesh_migrate_class's shard_map, so the
+                # local shard index is addressable.  While the drain is
+                # armed, ALL migration freezes except evacuation: rows
+                # standing on the draining shard route to their remapped
+                # owner (never the draining shard itself — the remap has
+                # no fixed point there), everyone else re-homes to where
+                # they already stand.  Routing by spatial owner instead
+                # would keep a trickle of through-traffic hopping ACROSS
+                # the draining bank (ring transit is one shard per
+                # tick), and under continuous motion churn the bank then
+                # never empties — the drain blows its tick bound.
+                # Spatial rebalance pauses for the few evacuation ticks
+                # and resumes when clear_exodus() re-arms normal routing.
+                mapped = jnp.take(exodus, owner)
+                me = jax.lax.axis_index(SHARD_AXIS)
+                draining_here = jnp.take(exodus, me) != me
+                owner = jnp.where(draining_here, mapped, me)
+            return owner
 
-        cs2, stats = mesh_migrate_class(
-            cs, self.mesh, owner_fn, pl.mig_budget
+        # the tick's fired mask was computed pre-migration; it must move
+        # WITH the row or a migrant's timer fire lands on its vacated
+        # (dead) slot and every later handler silently skips it
+        fired = ctx._fired.get(pl.class_name)
+        extras = [fired] if fired is not None and fired.shape[1] else None
+
+        # the module's mesh is generation-safe by contract: every elastic
+        # reshard retarget()s it and invalidates before the re-trace
+        out = mesh_migrate_class(
+            cs, self.mesh, owner_fn, pl.mig_budget,  # nf-lint: disable=mesh-not-captured -- retarget()+invalidate() re-aim it pre-retrace
+            extra_leaves=extras,
         )
+        if extras is None:
+            cs2, stats = out
+        else:
+            cs2, stats, (new_fired,) = out
+            # vacated source slots keep stale mask bytes; dead rows never
+            # fire, so pin the invariant here rather than trust consumers
+            ctx.remap_fired(pl.class_name, new_fired & cs2.alive[:, None])
         ctx.count("migrated", jnp.sum(stats[:, 0]))
         ctx.count("mig_overflow", jnp.sum(stats[:, 1]))
         state = with_class(state, pl.class_name, cs2)

@@ -62,37 +62,47 @@ class ShardedKernel:
     def __init__(self, kernel: Kernel, n_devices: Optional[int] = None, mesh: Optional[Mesh] = None):
         self.kernel = kernel
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
-        n_dev = self.mesh.devices.size
-        # tiny control-plane classes (IObject/Scene/config singletons)
-        # REPLICATE when their capacity doesn't divide the mesh — a
-        # 16-device dryrun must not fail on an 8-row class, and a few
-        # redundant rows cost nothing.  Anything bigger still errors:
-        # silently replicating a real entity bank (8x memory, zero
-        # speedup) would be a perf trap.
+        self.replicated_classes = self._scan_classes(self.mesh)
+        self._jit_step = None
+        self._jit_step1 = None
+        self._jit_run = None
+        self._shardings = None
+        self._shardings_key = None
+        self._seen_trace_gen = getattr(kernel, "_trace_gen", 0)
+
+    def _scan_classes(self, mesh: Mesh):
+        """Capacity/divisibility policy for one mesh width.
+
+        Tiny control-plane classes (IObject/Scene/config singletons)
+        REPLICATE when their capacity doesn't divide the mesh — a
+        16-device dryrun must not fail on an 8-row class, and a few
+        redundant rows cost nothing.  Anything bigger still errors:
+        silently replicating a real entity bank (8x memory, zero
+        speedup) would be a perf trap.  Re-run on every reshard — a
+        width legal at construction may be illegal after a grow."""
+        n_dev = mesh.devices.size
         replicate_limit = max(64, 2 * n_dev)
-        self.replicated_classes = []
-        for cname in kernel.store.class_order:
-            cap = kernel.store.capacity(cname)
+        replicated = []
+        for cname in self.kernel.store.class_order:
+            cap = self.kernel.store.capacity(cname)
             if cap % n_dev != 0:
                 if cap <= replicate_limit:
-                    self.replicated_classes.append(cname)
+                    replicated.append(cname)
                     continue
                 raise ValueError(
                     f"class {cname!r} capacity {cap} not divisible by "
                     f"{n_dev} devices — pad StoreConfig.capacities"
                 )
-        if self.replicated_classes:
+        if replicated:
             import warnings
 
             warnings.warn(
-                f"ShardedKernel: classes {self.replicated_classes} have "
+                f"ShardedKernel: classes {replicated} have "
                 f"capacities not divisible by {n_dev} devices and will be "
                 f"REPLICATED on every device",
                 stacklevel=2,
             )
-        self._jit_step = None
-        self._jit_run = None
-        self._seen_trace_gen = getattr(kernel, "_trace_gen", 0)
+        return replicated
 
     def _sync_generation(self) -> None:
         """Drop the sharded traces when the wrapped kernel invalidated.
@@ -107,23 +117,70 @@ class ShardedKernel:
             self._jit_step = None
             self._jit_step1 = None
             self._jit_run = None
+            self._shardings = None
             self._seen_trace_gen = gen
 
     # -- placement -----------------------------------------------------------
+
+    def shardings(self):
+        """The sharding pytree for the CURRENT state structure on the
+        CURRENT mesh — the single derivation the place/compile paths
+        share (previously four per-call ``world_shardings`` walks).
+
+        Cached keyed on (mesh, aux keyset): late-registered aux changes
+        the state pytree structure, so priming between calls re-derives;
+        ``reshard``/``_sync_generation`` invalidate explicitly."""
+        key = (self.mesh, tuple(sorted(self.kernel.state.aux.keys())))
+        if self._shardings is None or self._shardings_key != key:
+            self._shardings = world_shardings(self.kernel.state, self.mesh)
+            self._shardings_key = key
+        return self._shardings
 
     def place(self) -> None:
         # prime registered aux first: the sharding pytree must match the
         # state pytree structurally, and priming later would leave new
         # leaves off-mesh
         self.kernel._ensure_aux()
-        shardings = world_shardings(self.kernel.state, self.mesh)
-        self.kernel.state = jax.device_put(self.kernel.state, shardings)
+        self.kernel.state = jax.device_put(self.kernel.state, self.shardings())
+
+    def reshard(self, new_mesh: Optional[Mesh] = None,
+                cause: str = "reshard") -> Mesh:
+        """Re-place the LIVE world onto ``new_mesh`` (or onto the current
+        mesh when None — the cross-engine snapshot-load path).
+
+        Zero dropped rows by construction: the leading capacity axis is
+        block-partitioned, so a row's shard is a pure function of its
+        global index, and the global index never changes here — growing
+        2→8 or shrinking 8→2 re-slices the same axis.  (Evicting a
+        SPECIFIC device first drains row contents toward survivors via
+        the exodus protocol in parallel/elastic.py, then calls this.)
+
+        Every call announces a CostBook generation bump BEFORE dropping
+        traces, so the recompiles the new topology forces are sanctioned
+        — ``unexplained_since()`` stays clean — and drops Verlet/binning
+        aux caches exactly like row arrival does (kernel.invalidate)."""
+        old_n = self.mesh.devices.size
+        mesh = self.mesh if new_mesh is None else new_mesh
+        self.replicated_classes = self._scan_classes(mesh)
+        k = self.kernel
+        k.costbook.generation_bump(
+            f"{cause}:{old_n}->{mesh.devices.size}")
+        k.invalidate()
+        self.mesh = mesh
+        self._jit_step = None
+        self._jit_step1 = None
+        self._jit_run = None
+        self._shardings = None
+        self._seen_trace_gen = getattr(k, "_trace_gen", 0)
+        k._ensure_aux()
+        k.state = jax.device_put(k.state, self.shardings())
+        return mesh
 
     # -- compiled sharded step ----------------------------------------------
 
     def _compile(self):
         if self._jit_step is None:
-            shardings = world_shardings(self.kernel.state, self.mesh)
+            shardings = self.shardings()
             self._jit_step = self.kernel.costbook.wrap(
                 "kernel.sharded_step", self.kernel._trace_step,
                 donate_argnums=0, stage="tick",
@@ -167,7 +224,7 @@ class ShardedKernel:
         """One sharded step returning ONLY the state (host outputs
         dead-code-eliminated) — the benchmark-loop body."""
         if getattr(self, "_jit_step1", None) is None:
-            shardings = world_shardings(self.kernel.state, self.mesh)
+            shardings = self.shardings()
 
             def step1(st):
                 st2, _out = self.kernel._trace_step(st)
@@ -204,7 +261,7 @@ class ShardedKernel:
             # traced trip count: one compile serves every n (matches
             # Kernel.run_device; a per-n recompile at 512k x 8 devices
             # is ~minutes of XLA wall)
-            shardings = world_shardings(self.kernel.state, self.mesh)
+            shardings = self.shardings()
 
             def body(_, st):
                 st2, _out = self.kernel._trace_step(st)

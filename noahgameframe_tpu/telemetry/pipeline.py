@@ -138,7 +138,8 @@ class StageClock:
     """
 
     STAGES: Tuple[str, ...] = ("tick", "migrate", "harvest", "interest",
-                               "encode", "assemble", "send", "other")
+                               "encode", "assemble", "send", "reshard",
+                               "other")
 
     def __init__(self, registry=None, window: int = 512):
         self._acc: Dict[str, int] = {}

@@ -179,8 +179,21 @@ while :; do
   run_item b100k_walk 900 python -u bench.py --entities 100000 --ticks 90 --no-combat --platform tpu \
     && save_json b100k_walk bench_runs/r05_tpu_100k_nocombat.json
 
+  # 10. elastic reshard on chip (ISSUE 17 r10): grow 2->4, drain->3
+  #     over REAL devices.  Guarded: the ladder needs >=4 chips, and a
+  #     v4-8 tunnel sometimes exposes a single-chip slice — probe the
+  #     device count first so the item fails fast without burning the
+  #     window (unstamped items retry next pass).
+  if timeout 110 python -c "import jax; assert len(jax.devices())>=4" >/dev/null 2>&1; then
+    run_item reshard4 1800 python -u bench.py --reshard 4 --platform tpu \
+        --mig-entities 12000,60000 --mig-budgets 512,2048 \
+      && save_json reshard4 bench_runs/r10_elastic_tpu.json
+  else
+    echo "[$(date -u +%H:%M:%S)] SKIP reshard4 — backend exposes <4 devices"
+  fi
+
   n_done=$(ls "$STAMPS" | wc -l)
-  if [ "$n_done" -ge 22 ]; then
+  if [ "$n_done" -ge 23 ]; then
     echo "[$(date -u +%H:%M:%S)] queue drained — exiting"
     exit 0
   fi

@@ -660,3 +660,28 @@ class TestGamedayE2E:
         assert blob["metric"] == "gameday_sessions_rehomed_per_sec"
         assert blob["detail"]["replay_ok"] is True
         assert blob["detail"]["drill_clean"] is True
+
+
+# --------------------------------------- the elastic-mesh flagship drill
+@pytest.fixture(scope="module")
+def reshard():
+    return _load_script("reshard_smoke")
+
+
+class TestReshardE2E:
+    def test_reshard_short_campaign(self, reshard, tmp_path):
+        # tier-1 sized: 4 sessions, 2 chats — same campaign shape
+        # (grow 2→4 under surge, drain a device, heal), ~30 s
+        checks = reshard.run(tmp_path, seed=7, sessions=4, chats=2)
+        failed = [name for name, ok in checks.items() if not ok]
+        assert not failed
+
+    @pytest.mark.slow
+    def test_reshard_full_campaign(self, reshard, tmp_path):
+        checks = reshard.run(tmp_path, seed=7, sessions=12, chats=4,
+                             out_path=tmp_path / "r10_reshard.json")
+        failed = [name for name, ok in checks.items() if not ok]
+        assert not failed
+        blob = json.loads((tmp_path / "r10_reshard.json").read_text())
+        assert blob["metric"] == "reshard_gameday_exodus_ticks"
+        assert blob["detail"]["drill_clean"] is True

@@ -1,0 +1,485 @@
+"""Elastic mesh (ISSUE 17): grow/drain a live world, digest-pinned.
+
+The contracts:
+
+1. a serving world grows 2→4 devices and drains back down to 2 (through
+   a 3-device mesh — widths need not be powers of two) with per-phase
+   ``canonical_digest`` parity against a single-shard control, zero
+   dropped rows, population conserved, and every forced recompile
+   explained by a CostBook generation bump (``unexplained_since`` gate),
+2. moved-row detection is IDENTITY-based — content churn (regen ticking
+   HP) never reads as movement, so a reshard force-resets exactly the
+   sessions whose seen rows actually re-homed (``sessions_seeing_rows``),
+3. the :class:`StableUnderReshard` drill invariant fires on forged
+   dropped-row / pop-leak / exodus-lag / digest-divergence clusters and
+   stays silent on a healthy one,
+4. the :class:`Autoscaler` is hysteretic: one hot sample never grows,
+   ``consecutive`` breaches do, and the cooldown gags the follow-up.
+"""
+
+import dataclasses
+from types import SimpleNamespace
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from noahgameframe_tpu.core.schema import ClassDef, ClassRegistry, prop, record
+from noahgameframe_tpu.core.store import StoreConfig, with_class
+from noahgameframe_tpu.kernel.kernel import Kernel
+from noahgameframe_tpu.kernel.module import Module
+from noahgameframe_tpu.parallel.elastic import (
+    Autoscaler,
+    AutoscalePolicy,
+    ElasticMesh,
+)
+from noahgameframe_tpu.parallel.mesh import make_mesh
+from noahgameframe_tpu.parallel.rowmigrate import (
+    RowMigrationModule,
+    SpatialPlacement,
+    canonical_digest,
+)
+from noahgameframe_tpu.parallel.shard import ShardedKernel
+
+EXTENT = 64.0
+CAP = 48     # divisible by 1..4, 6, 8 — every width this file visits
+N_LIVE = 24
+
+
+class _Drift(Module):
+    name = "drift"
+
+    def __init__(self):
+        super().__init__()
+        self.add_phase("move", self._move, order=10)
+
+    def _move(self, state, ctx):
+        cs = state.classes["Npc"]
+        y = jnp.mod(cs.vec[:, 0, 1] + 3.0, EXTENT)
+        return with_class(state, "Npc",
+                          cs.replace(vec=cs.vec.at[:, 0, 1].set(y)))
+
+
+def _mk_world(n_shards: int):
+    reg = ClassRegistry()
+    reg.define(ClassDef(name="Npc", properties=[
+        prop("Id", "int"), prop("HP", "int"), prop("Position", "vector2"),
+    ], records=[
+        record("Bag", 3, [("item", "int"), ("weight", "float")]),
+    ]))
+    k = Kernel(reg, store_config=StoreConfig(
+        default_capacity=CAP, capacities={"Npc": CAP},
+        timer_slots={"Npc": 2},
+    ), seed=0)
+    mesh = make_mesh(n_shards)
+    mig = RowMigrationModule(SpatialPlacement(
+        class_name="Npc", pos_prop="Position", extent=EXTENT,
+        cell_size=8.0, width=8, n_shards=n_shards, mig_budget=6,
+    ), mesh=mesh, order=20)
+    k.build([_Drift(), mig])
+    mig.bind(k)
+
+    rng = np.random.default_rng(7)
+    i32 = np.zeros((CAP, 2), np.int32)
+    i32[:, 0] = np.arange(CAP)
+    i32[:N_LIVE, 1] = 100
+    vec = np.zeros((CAP, 1, 3), np.float32)
+    vec[:N_LIVE, 0, 0] = rng.uniform(1.0, EXTENT - 1, N_LIVE)
+    vec[:N_LIVE, 0, 1] = rng.uniform(1.0, EXTENT - 1, N_LIVE)
+    alive = np.zeros(CAP, bool)
+    alive[:N_LIVE] = True
+    cs = k.state.classes["Npc"].replace(
+        i32=jnp.asarray(i32), vec=jnp.asarray(vec), alive=jnp.asarray(alive))
+    k.state = with_class(k.state, "Npc", cs)
+
+    sk = ShardedKernel(k, mesh=mesh)
+    sk.place()
+    return k, sk, mig
+
+
+def _digest(k):
+    return canonical_digest(k.state, ["Npc"], {"Npc": 0})
+
+
+# --------------------------------------------------------------- tentpole
+
+
+def test_grow_drain_digest_parity_vs_static_control():
+    """2→4 grow, 4→3→2 drains: every phase bit-identical to the 1-shard
+    control, zero drops, pop conserved, recompiles all explained."""
+    k, sk, mig = _mk_world(2)
+    kc, skc, _ = _mk_world(1)
+    el = ElasticMesh(sk, migration=mig, ident_cols={"Npc": 0},
+                     exodus_tick_bound=64)
+
+    def step_both(n=1):
+        for _ in range(n):
+            sk.run_device(1, fused=False)
+            skc.run_device(1, fused=False)
+
+    def parity(tag):
+        assert _digest(k) == _digest(kc), f"{tag}: digest divergence"
+        assert int(np.asarray(
+            k.state.classes["Npc"].alive).sum()) == N_LIVE, f"{tag}: pop"
+
+    step_both(4)
+    parity("warm@2")
+    mark = k.costbook.mark()
+
+    el.begin_grow(4)
+    assert el.inflight == "grow"
+    with pytest.raises(RuntimeError, match="already in flight"):
+        el.begin_grow(8)
+    for _ in range(40):
+        el.poll()
+        if el.inflight is None:
+            break
+        step_both(1)
+    assert el.inflight is None, "grow never settled"
+    assert el.n_devices == 4
+    parity("after grow to 4")
+    grow_op = el.ops_done[-1]
+    assert grow_op["kind"] == "grow"
+    assert grow_op["pop_after"] == grow_op["pop_before"] == N_LIVE
+
+    step_both(3)
+    parity("settled@4")
+
+    # drain mesh position 1 — the survivors close ranks around it
+    el.begin_drain(1)
+    for _ in range(200):
+        el.poll()
+        if el.inflight is None:
+            break
+        step_both(1)
+    assert el.inflight is None, "drain never completed"
+    assert el.n_devices == 3
+    parity("after drain to 3")
+    drain_op = el.ops_done[-1]
+    assert drain_op["kind"] == "drain"
+    assert drain_op["drained_in_budget"], "exodus blew its tick bound"
+    assert drain_op["exodus_ticks"] <= 64
+
+    el.begin_drain(2)
+    for _ in range(200):
+        el.poll()
+        if el.inflight is None:
+            break
+        step_both(1)
+    assert el.n_devices == 2
+    step_both(3)
+    parity("settled@2")
+
+    st = el.status()
+    assert st["dropped_rows"] == 0
+    assert st["resharded_total"] == 3
+    assert st["pop"] == st["pop_baseline"] == N_LIVE
+    assert k.costbook.unexplained_since(mark) == [], (
+        "reshard recompiles must all be generation-sanctioned")
+
+
+def test_grow_without_migration_is_pure_replace():
+    """A world with NO migrate phase still grows: one content-preserving
+    re-place, completed on the first poll."""
+    reg = ClassRegistry()
+    reg.define(ClassDef(name="Npc", properties=[
+        prop("Id", "int"), prop("HP", "int"), prop("Position", "vector2"),
+    ]))
+    k = Kernel(reg, store_config=StoreConfig(
+        default_capacity=CAP, capacities={"Npc": CAP}), seed=0)
+    k.build([_Drift()])
+    cs = k.state.classes["Npc"]
+    k.state = with_class(k.state, "Npc", cs.replace(
+        i32=cs.i32.at[:, 0].set(jnp.arange(CAP)),
+        alive=cs.alive.at[:N_LIVE].set(True)))
+    sk = ShardedKernel(k, mesh=make_mesh(1))
+    sk.place()
+    el = ElasticMesh(sk, migration=None, ident_cols={"Npc": 0})
+    sk.run_device(2, fused=False)
+    before = _digest(k)
+    el.begin_grow(2)
+    moved = el.poll()
+    assert el.inflight is None
+    assert el.n_devices == 2
+    assert _digest(k) == before
+    # without a migrating class there is nothing to report moved
+    assert moved == {}
+    sk.run_device(2, fused=False)   # still ticks on the wider mesh
+
+
+class _Pulse(Module):
+    """Timer consumer running AFTER the migrate phase (order 40 vs 20),
+    like RegenModule in the real world wiring."""
+
+    name = "pulse"
+
+    def __init__(self):
+        super().__init__()
+        self.add_phase("pulse", self._p, order=40)
+
+    def _p(self, state, ctx):
+        cs = state.classes["Npc"]
+        hit = ctx.fired("Npc", "beat") & cs.alive
+        hp = jnp.where(hit, cs.i32[:, 1] + 7, cs.i32[:, 1])
+        return with_class(state, "Npc",
+                          cs.replace(i32=cs.i32.at[:, 1].set(hp)))
+
+
+def test_fired_mask_migrates_with_row():
+    """A timer fire landing on the SAME tick its row crosses a shard
+    boundary must still reach handlers that run after the migrate phase.
+    The schedule computes fired masks before phases run, so the migrate
+    phase has to carry the mask with the row — otherwise the fire stays
+    on the vacated (dead) slot and the handler silently skips it."""
+    reg = ClassRegistry()
+    reg.define(ClassDef(name="Npc", properties=[
+        prop("Id", "int"), prop("HP", "int"), prop("Position", "vector2"),
+    ]))
+    k = Kernel(reg, store_config=StoreConfig(
+        default_capacity=CAP, capacities={"Npc": CAP},
+        timer_slots={"Npc": 1},
+    ), seed=0)
+    k.schedule.register_timer("Npc", "beat")
+    mesh = make_mesh(2)
+    mig = RowMigrationModule(SpatialPlacement(
+        class_name="Npc", pos_prop="Position", extent=EXTENT,
+        cell_size=8.0, width=8, n_shards=2, mig_budget=6,
+    ), mesh=mesh, order=20)
+    k.build([_Drift(), mig, _Pulse()])
+    mig.bind(k)
+
+    # row 0 parks mid-slab (never migrates); row 1 starts at y=27 so the
+    # drift (+3/tick) pushes it across the y=32 slab boundary on the
+    # second step — exactly when its timer (delay 1, armed at tick 0)
+    # first satisfies tick >= next_fire
+    i32 = np.zeros((CAP, 2), np.int32)
+    i32[:, 0] = np.arange(CAP)
+    i32[:2, 1] = 100
+    vec = np.zeros((CAP, 1, 3), np.float32)
+    vec[0, 0, :2] = (10.0, 10.0)
+    vec[1, 0, :2] = (5.0, 27.0)
+    alive = np.zeros(CAP, bool)
+    alive[:2] = True
+    cs = k.state.classes["Npc"].replace(
+        i32=jnp.asarray(i32), vec=jnp.asarray(vec), alive=jnp.asarray(alive))
+    k.state = with_class(k.state, "Npc", cs)
+    k.state = k.schedule.set_timer_rows(
+        k.state, "Npc", np.asarray([0, 1]), "beat", interval_s=10.0,
+        start_delay_ticks=np.asarray([1, 1]))
+
+    sk = ShardedKernel(k, mesh=mesh)
+    sk.place()
+    sk.run_device(2, fused=False)
+
+    i32 = np.asarray(k.state.classes["Npc"].i32)
+    alive = np.asarray(k.state.classes["Npc"].alive)
+    where_id1 = int(np.flatnonzero(alive & (i32[:, 0] == 1))[0])
+    assert where_id1 >= CAP // 2, "row 1 should have migrated to shard 1"
+    assert i32[where_id1, 1] == 107, "migrant's fire was lost mid-flight"
+    where_id0 = int(np.flatnonzero(alive & (i32[:, 0] == 0))[0])
+    assert i32[where_id0, 1] == 107
+
+
+def test_begin_guards():
+    k, sk, mig = _mk_world(2)
+    el = ElasticMesh(sk, migration=mig, ident_cols={"Npc": 0})
+    with pytest.raises(ValueError, match="grow_mesh"):
+        el.begin_grow(2)            # not an expansion
+    with pytest.raises(ValueError, match="out of range"):
+        el.begin_drain(5)
+    k1, sk1, mig1 = _mk_world(1)
+    el1 = ElasticMesh(sk1, migration=mig1)
+    with pytest.raises(ValueError, match="last device"):
+        el1.begin_drain(0)
+
+
+def test_scan_classes_rejects_large_non_divisible_capacity():
+    """A real entity bank whose capacity doesn't divide the mesh is a
+    hard error (silent replication would be an 8x memory perf trap)."""
+    reg = ClassRegistry()
+    reg.define(ClassDef(name="Big", properties=[prop("Id", "int")]))
+    k = Kernel(reg, store_config=StoreConfig(
+        default_capacity=144, capacities={"Big": 144}), seed=0)
+    k.build([])
+    with pytest.raises(ValueError, match="not divisible"):
+        ShardedKernel(k, mesh=make_mesh(5))
+
+
+# --------------------------------------------- moved rows / serve coherence
+
+
+def test_moved_rows_are_identity_based_not_content_based():
+    """Content churn (HP regen) must not read as row movement — only an
+    (identity, liveness) change marks a row's serve mirrors stale."""
+    k, sk, mig = _mk_world(2)
+    el = ElasticMesh(sk, migration=mig, ident_cols={"Npc": 0})
+    snap = el._snapshot()
+
+    cs = k.state.classes["Npc"]
+    k.state = with_class(k.state, "Npc",
+                         cs.replace(i32=cs.i32.at[:, 1].add(7)))  # HP only
+    assert el._moved_since(snap)["Npc"].size == 0
+
+    cs = k.state.classes["Npc"]
+    k.state = with_class(
+        k.state, "Npc",
+        cs.replace(i32=cs.i32.at[3, 0].set(999),        # row 3 re-homed
+                   alive=cs.alive.at[5].set(False)))    # row 5 despawned
+    moved = el._moved_since(snap)["Npc"]
+    assert set(moved.tolist()) == {3, 5}
+
+
+def test_sessions_seeing_rows_resets_only_affected_sessions():
+    from noahgameframe_tpu.net.serving import (
+        SessionTable,
+        sessions_seeing_rows,
+    )
+    from noahgameframe_tpu.ops.serving import SENTINEL
+
+    tbl = SessionTable(lo=4)
+    tbl.ensure("watcher", conn_id=1, avatar_row=0)
+    tbl.ensure("bystander", conn_id=2, avatar_row=1)
+    seen = tbl.seen_for("Npc", 4)
+    rows = np.asarray(seen.rows).copy()
+    rows[tbl.slot_of["watcher"]] = [3, 9, SENTINEL, SENTINEL]
+    rows[tbl.slot_of["bystander"]] = [1, 2, SENTINEL, SENTINEL]
+    tbl.store_seen("Npc", seen._replace(rows=jnp.asarray(rows)))
+
+    assert sessions_seeing_rows(tbl, "Npc", np.array([9, 30])) == ["watcher"]
+    assert sessions_seeing_rows(tbl, "Npc", np.array([], np.int64)) == []
+    both = sessions_seeing_rows(tbl, "Npc", np.array([2, 3]))
+    assert sorted(both) == ["bystander", "watcher"]
+    # SENTINEL padding never matches a moved row
+    assert sessions_seeing_rows(tbl, "Npc", np.array([SENTINEL])) == []
+
+
+# ------------------------------------------------------- drill invariant
+
+
+def _forged_cluster(status, digest=None, tick=10):
+    elastic = SimpleNamespace(status=lambda: status,
+                              digest=lambda: digest)
+    game = SimpleNamespace(
+        elastic=elastic,
+        kernel=SimpleNamespace(tick_count=tick),
+        config=SimpleNamespace(name="game6"),
+    )
+    return SimpleNamespace(games=[game])
+
+
+def _check(inv, cluster):
+    from noahgameframe_tpu.drill.invariants import DrillContext
+
+    return inv.check(DrillContext(cluster=cluster, tick=0, now=0.0))
+
+
+def _healthy_status(**over):
+    st = {
+        "devices": 2, "inflight": None, "stage": None,
+        "exodus_ticks": 3, "exodus_tick_bound": 64,
+        "dropped_rows": 0, "rows_moved_total": 5,
+        "pop": 24, "pop_baseline": 24,
+        "resharded_total": 1, "generation": 4,
+    }
+    st.update(over)
+    return st
+
+
+def test_stable_under_reshard_clean_cluster_is_silent():
+    from noahgameframe_tpu.drill.invariants import StableUnderReshard
+
+    inv = StableUnderReshard()
+    assert _check(inv, _forged_cluster(_healthy_status())) == []
+    # non-elastic games are skipped, not crashed on
+    plain = SimpleNamespace(games=[SimpleNamespace(elastic=None)])
+    assert _check(inv, plain) == []
+
+
+def test_stable_under_reshard_flags_forged_breaches():
+    from noahgameframe_tpu.drill.invariants import StableUnderReshard
+
+    inv = StableUnderReshard()
+    v = _check(inv, _forged_cluster(_healthy_status(dropped_rows=2)))
+    assert v and "dropped 2 row" in v[0]
+
+    v = _check(inv, _forged_cluster(_healthy_status(pop=23)))
+    assert v and "population not conserved" in v[0]
+
+    v = _check(inv, _forged_cluster(_healthy_status(
+        inflight="drain", exodus_ticks=99)))
+    assert v and "exodus lag 99" in v[0]
+
+    # in-flight ops defer the pop clause (rows are mid-hop by design)
+    v = _check(inv, _forged_cluster(_healthy_status(
+        inflight="grow", pop=23)))
+    assert v == []
+
+
+def test_stable_under_reshard_digest_clause_pins_control():
+    from noahgameframe_tpu.drill.invariants import StableUnderReshard
+
+    control = SimpleNamespace(tick_count=8,
+                              advance_to=lambda t: 0xAB)
+    inv = StableUnderReshard(control=control)
+    v = _check(inv, _forged_cluster(_healthy_status(),
+                                    digest=0xAB, tick=10))
+    assert v == []
+    inv2 = StableUnderReshard(control=control)
+    v = _check(inv2, _forged_cluster(_healthy_status(),
+                                     digest=0xCD, tick=10))
+    assert v and "digest diverged" in v[0]
+    # each tick is checked once — a second sample at the same tick
+    # doesn't re-run (or re-flag) the digest
+    assert _check(inv2, _forged_cluster(_healthy_status(),
+                                        digest=0xCD, tick=10)) == []
+
+
+# ------------------------------------------------------------- autoscaler
+
+
+def test_autoscaler_requires_consecutive_breaches_and_cools_down():
+    pol = AutoscalePolicy(consecutive=3, cooldown_polls=5, max_devices=8)
+    a = Autoscaler(pol)
+    hot = {"tick_p95_ms": 80.0}
+    assert a.observe(hot, devices=2) is None
+    assert a.observe(hot, devices=2) is None
+    assert a.observe(hot, devices=2) == "grow"
+    # cooldown gags the immediate follow-up even though still hot
+    for _ in range(pol.cooldown_polls):
+        assert a.observe(hot, devices=4) is None
+    # one cold sample resets the hot streak
+    a2 = Autoscaler(pol)
+    a2.observe(hot, 2)
+    a2.observe({"tick_p95_ms": 1.0}, 2)
+    a2.observe(hot, 2)
+    assert a2.observe(hot, 2) is None
+
+
+def test_autoscaler_drains_when_cold_and_respects_bounds():
+    pol = AutoscalePolicy(consecutive=2, cooldown_polls=0,
+                          min_devices=2, max_devices=4)
+    a = Autoscaler(pol)
+    cold = {"tick_p95_ms": 1.0}
+    assert a.observe(cold, devices=4) is None
+    assert a.observe(cold, devices=4) == "drain"
+    # at the floor: stays put no matter how cold
+    a.observe(cold, 2)
+    a.observe(cold, 2)
+    assert a.observe(cold, devices=2) is None
+    # at the ceiling: stays put no matter how hot
+    hot = {"hbm_frac": 0.99}
+    a.observe(hot, 4)
+    assert a.observe(hot, devices=4) is None
+    # a missing signal doesn't vote either way
+    assert a.observe({}, devices=4) is None
+
+
+def test_elastic_autoscale_hook_fires_grow():
+    k, sk, mig = _mk_world(2)
+    el = ElasticMesh(sk, migration=mig, ident_cols={"Npc": 0},
+                     autoscaler=Autoscaler(AutoscalePolicy(
+                         consecutive=1, cooldown_polls=0, max_devices=4)))
+    assert el.maybe_autoscale({"tick_p95_ms": 500.0}) == "grow"
+    assert el.inflight == "grow"
+    # in-flight op suppresses further decisions
+    assert el.maybe_autoscale({"tick_p95_ms": 500.0}) is None
