@@ -317,6 +317,130 @@ def _costbook_detail(book, pipeline_stats=None) -> dict:
     return out
 
 
+def _combat_cost_probe(world) -> dict:
+    """Attribute the combat fold's compiled cost to a per-engine
+    CostBook entry (``combat.fold_p0/p1/p2``) from the final world
+    state, OUTSIDE the timed region — so ``detail.costbook.entries``
+    carries the split-vs-fused ``bytes_accessed`` the r11 A/B compares
+    from the same ledger as everything else.  Probes the engine the run
+    actually used (including the fused path's VMEM downgrade), one
+    compile + one call; the fold math and geometry are exactly the
+    combat phase's (`game/combat.py` is the source of truth)."""
+    combat = getattr(world, "combat", None)
+    if combat is None:
+        return {}
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from noahgameframe_tpu.game.combat import combat_fold_xla
+        from noahgameframe_tpu.ops.stencil import (
+            CellSlots,
+            CellTable,
+            build_cell_slots_pair,
+            build_cell_table_pair,
+        )
+        from noahgameframe_tpu.ops.stencil_pallas import (
+            combat_fold_pallas,
+            fused_fits_vmem,
+            fused_neighborhood,
+        )
+
+        k = world.kernel
+        cname = combat.class_name
+        spec = k.store.spec(cname)
+        cs = k.state.classes[cname]
+        pos = cs.vec[:, spec.slot("Position").col, :2]
+        alive = cs.alive
+        cap = alive.shape[0]
+        cell_size, width = combat.cell_size, combat.width
+        bucket = combat.resolved_bucket(cap)
+        att_bucket = combat.resolved_att_bucket(cap)
+        engine = combat.resolved_engine()
+        fell_back = False
+        if engine == 2:
+            fits, _need, _budget = fused_fits_vmem(cap, width, bucket,
+                                                   att_bucket)
+            if not fits:
+                engine, fell_back = 0, True
+
+        f32 = jnp.float32
+        camp_f = cs.i32[:, spec.slot("Camp").col].astype(f32)
+        scene_f = cs.i32[:, spec.slot("SceneID").col].astype(f32)
+        group_f = cs.i32[:, spec.slot("GroupID").col].astype(f32)
+        atk_f = cs.i32[:, spec.slot("ATK_VALUE").col].astype(f32)
+        interval = max(1, k.schedule.ticks_of(combat.attack_period_s))
+        attacking = alive & ((jnp.arange(cap) % interval) == 0)
+        interp = jax.default_backend() not in ("tpu", "axon")
+        book = k.costbook
+        entry = f"combat.fold_p{engine}"
+        radius = combat.radius
+
+        if engine == 2:
+            vic_s, att_s = build_cell_slots_pair(
+                pos, alive, attacking, cell_size, width, bucket, att_bucket
+            )
+            bank = jnp.stack(
+                [pos[:, 0], pos[:, 1], camp_f, scene_f, group_f, atk_f], -1
+            )
+            fold = book.wrap(
+                entry,
+                lambda bk, vso, aso: fused_neighborhood(
+                    bk,
+                    CellSlots(vso, jnp.int32(0), width, cell_size, bucket),
+                    CellSlots(aso, jnp.int32(0), width, cell_size,
+                              att_bucket),
+                    radius, interpret=interp,
+                ),
+                stage="aoe",
+            )
+            jax.block_until_ready(fold(bank, vic_s.slot_of, att_s.slot_of))
+        else:
+            rows_f = jnp.arange(cap, dtype=f32)
+            vic_f = jnp.stack(
+                [pos[:, 0], pos[:, 1], camp_f, scene_f, group_f], -1
+            )
+            att_f = jnp.stack(
+                [pos[:, 0], pos[:, 1], atk_f, camp_f, scene_f, group_f,
+                 rows_f], -1
+            )
+            vt, at = build_cell_table_pair(
+                pos, alive, vic_f, attacking, att_f,
+                cell_size, width, bucket, att_bucket,
+            )
+            if engine == 1:
+                fold = book.wrap(
+                    entry,
+                    lambda vp, vs, ap, as_: combat_fold_pallas(
+                        CellTable(vp, vs, jnp.int32(0), width, cell_size,
+                                  bucket),
+                        CellTable(ap, as_, jnp.int32(0), width, cell_size,
+                                  att_bucket),
+                        radius, interpret=interp,
+                    ),
+                    stage="aoe",
+                )
+            else:
+                fold = book.wrap(
+                    entry,
+                    lambda vp, vs, ap, as_: combat_fold_xla(
+                        CellTable(vp, vs, jnp.int32(0), width, cell_size,
+                                  bucket),
+                        CellTable(ap, as_, jnp.int32(0), width, cell_size,
+                                  att_bucket),
+                        radius,
+                    ),
+                    stage="aoe",
+                )
+            jax.block_until_ready(
+                fold(vt.payload, vt.slot_of, at.payload, at.slot_of)
+            )
+        return {"engine": engine, "vmem_fallback": fell_back,
+                "entry": entry}
+    except Exception as e:  # noqa: BLE001 — evidence, never a bench kill
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _grid_overflow_max(world) -> int:
     """Rebuild the combat victim cell-table from the final state once
     (outside the timed region) and report entities dropped by bucket
@@ -973,6 +1097,9 @@ def run_bench(args) -> dict:
     # fetches the on-device counter bank for the detail block below
     dp50, dp95, dp99 = _hist_pcts(dev_hist)
     grid_drop, att_drop = _overflow_gauges(world)
+    # per-engine combat-fold cost attribution (combat.fold_p{0,1,2} in
+    # detail.costbook.entries) — outside every timed region
+    pallas_probe = _combat_cost_probe(world)
 
     ticks_per_s = args.ticks / dt
     rate = n * ticks_per_s
@@ -1013,6 +1140,11 @@ def run_bench(args) -> dict:
             # which slot-assignment engine built the cell tables — the
             # label the count-vs-sort A/B (and decide_tuning) reads
             "binning": binning_mode(),
+            # which combat fold engine ran (0 split-XLA / 1 split-Pallas
+            # / 2 fused table-free), after any VMEM downgrade — the
+            # label the NF_PALLAS tri-state A/B joins on
+            **({"pallas_engine": pallas_probe.get("engine"),
+                "pallas_probe": pallas_probe} if pallas_probe else {}),
             **({"verlet": verlet} if verlet else {}),
             # compiled-cost evidence: compile wall, recompiles+causes,
             # HBM peak, per-entry FLOPs/bytes (telemetry/costbook.py)
@@ -1131,6 +1263,77 @@ def _run_session_sweep(args) -> dict:
             "sweep_ab": bool(args.sweep_ab),
             "baseline_artifact": "r05_served_100k_2000s_cpu.json",
             "baseline_frame_ms_p99": 726.402,
+            "points": points,
+        },
+    }
+
+
+def _run_pallas_ab(args) -> dict:
+    """--sweep-ab without --sweep-sessions: waterfall the three combat
+    engines (NF_PALLAS 0 split-XLA / 1 split-Pallas fold / 2 fused
+    table-free) in one invocation.  Each engine runs in a SUBPROCESS
+    with an explicit ``--pallas`` pin — the knob is read at trace time,
+    so respawning is the only way to get three honest traces — and a
+    crash or OOM in one engine can't burn the others' points.  Each
+    point keeps its ``combat.fold_p*`` costbook entry, so the r11
+    artifact reads split-vs-fused bytes_accessed from one payload."""
+    def one(engine: int) -> dict:
+        cmd = [
+            sys.executable, "-u", __file__,
+            "--entities", str(args.entities), "--ticks", str(args.ticks),
+            "--seed", str(args.seed), "--platform", args.platform,
+            "--pallas", str(engine),
+        ]
+        if args.no_combat:
+            cmd.append("--no-combat")
+        point = {"pallas": engine}
+        try:
+            r = subprocess.run(
+                cmd, capture_output=True, text=True,
+                timeout=args.sweep_timeout,
+            )
+        except subprocess.TimeoutExpired:
+            point["error"] = f"timeout after {args.sweep_timeout:.0f}s"
+            return point
+        for ln in reversed((r.stdout or "").strip().splitlines()):
+            if ln.startswith("{"):
+                try:
+                    p = json.loads(ln)
+                except json.JSONDecodeError:
+                    break
+                if p.get("error"):
+                    point["error"] = p["error"]
+                point["value"] = p.get("value")
+                d = p.get("detail") or {}
+                for key in ("tick_ms", "tick_ms_p50_device", "platform",
+                            "pallas_engine", "pallas_probe", "binning"):
+                    point[key] = d.get(key)
+                entries = ((d.get("costbook") or {}).get("entries")) or {}
+                point["fold_entries"] = {
+                    name: e for name, e in entries.items()
+                    if name.startswith("combat.fold_")
+                }
+                return point
+        point["error"] = f"rc={r.returncode}"
+        point["tail"] = (r.stderr or "").strip().splitlines()[-3:]
+        return point
+
+    points = [one(e) for e in (0, 1, 2)]
+    head = next(
+        (p for p in points if p.get("value") and not p.get("error")), None
+    )
+    return {
+        "metric": "pallas_engine_ab",
+        "value": head["value"] if head else 0.0,
+        "unit": "entity-ticks/s",
+        "vs_baseline": round(
+            (head["value"] / NORTH_STAR_RATE) if head else 0.0, 4
+        ),
+        "detail": {
+            "entities": args.entities,
+            "ticks": args.ticks,
+            "seed": args.seed,
+            "platform": args.platform,
             "points": points,
         },
     }
@@ -1269,7 +1472,18 @@ def main() -> None:
     ap.add_argument(
         "--sweep-ab", action="store_true",
         help="with --sweep-sessions: also run the legacy engine at "
-             "every count (before/after waterfall pairs)",
+             "every count (before/after waterfall pairs).  Without "
+             "--sweep-sessions: waterfall the three combat engines "
+             "(--pallas 0/1/2), each in a subprocess, into one payload",
+    )
+    ap.add_argument(
+        "--pallas", type=int, choices=(0, 1, 2), default=None,
+        help="combat fold engine: 0 split-table XLA stencil, 1 "
+             "split-table Pallas fold, 2 fused table-free neighborhood "
+             "engine (VMEM-oversize configs downgrade to 0).  Sets "
+             "NF_PALLAS for this process — the knob is read at trace "
+             "time, so A/B sweeps respawn one subprocess per engine; "
+             "overrides bench_runs/tuning.json",
     )
     ap.add_argument(
         "--sweep-timeout", type=float, default=900.0,
@@ -1340,6 +1554,22 @@ def main() -> None:
     )
     args = ap.parse_args()
     pinned = args.entities is not None or args.ticks is not None
+    if args.pallas is not None:
+        # trace-time knob: must sit in the environment before the first
+        # world build; an explicit flag beats tuning.json (which applies
+        # via setdefault) and the inherited environment alike
+        os.environ["NF_PALLAS"] = str(args.pallas)
+
+    if args.sweep_ab and not args.sweep_sessions and not args.served:
+        # the engine-waterfall parent never touches jax — every engine
+        # point is a subprocess (NF_PALLAS is a trace-time knob: only a
+        # respawn gives each engine an honest fresh trace)
+        if args.entities is None:
+            args.entities = 20_000  # the r11 acceptance geometry
+        if args.ticks is None:
+            args.ticks = 30
+        _emit(_run_pallas_ab(args))
+        return
 
     if args.served and args.sweep_sessions:
         # the sweep parent never touches jax — every point is a CPU
@@ -1472,6 +1702,8 @@ def main() -> None:
             if args.no_combat:
                 serve.append("--no-combat")
             serve += ["--seed", str(args.seed)]
+            if args.pallas is not None:
+                serve += ["--pallas", str(args.pallas)]
             _run_ladder(note, serve)
             return
     # platform == "tpu": let the default (axon) backend initialise in-process

@@ -31,11 +31,20 @@ from ..core.store import HANDLE_ROW_BITS, WorldState, with_class
 from ..kernel.module import Module
 from ..ops.stencil import (
     auto_bucket,
+    build_cell_slots_pair,
     build_cell_table_pair,
-    pull,
+    pull_slots,
+    slots_from_assignment,
     stencil_fold,
 )
-from ..ops.verlet import full_table, init_cache, refresh, skin_from_env, sub_table
+from ..ops.verlet import (
+    full_table,
+    init_cache,
+    refresh,
+    skin_from_env,
+    sub_slots,
+    sub_table,
+)
 from .defines import GameEvent
 
 ATTACK_TIMER = "Attack"
@@ -153,7 +162,7 @@ class CombatModule(Module):
         attack_period_s: float = 1.0,
         order: int = 30,
         emit_events: bool = True,
-        use_pallas: Optional[bool] = None,
+        use_pallas: Optional[int] = None,
         verlet_skin: Optional[float] = None,
     ):
         super().__init__()
@@ -189,12 +198,21 @@ class CombatModule(Module):
         self.overflow_total = 0
         self.overflow_alerts = 0
         self._overflow_log_muted = False
-        # None = env-gated (NF_PALLAS=1): the fused Pallas fold kernel
-        # (ops/stencil_pallas.py); opt-in until chip-time confirms a win.
-        # (The stencil engine is the only combat engine: at honest bucket
-        # sizes it beats the old per-candidate-gather pipeline even on a
-        # single CPU core — 103 ms vs 186 ms at 100k — and by ~25x on a
-        # v5e, where irregular gathers run at ~1% of HBM bandwidth.)
+        # tri-state Pallas engine selector (None = NF_PALLAS env knob):
+        #   0/False  XLA stencil fold over split cell tables
+        #   1/True   Pallas fold kernel over the same split tables
+        #            (ops/stencil_pallas.combat_fold_pallas)
+        #   2        fused table-free neighborhood engine: gather from
+        #            the SoA bank via slot ranks, fold combat + AOI
+        #            occupancy on-core, never materialize the payload
+        #            tables (ops/stencil_pallas.fused_neighborhood).
+        #            Downgrades to 0 when the tile footprint exceeds the
+        #            VMEM budget (nf_pallas_fallback_total metric).
+        # Opt-in until chip-time confirms a win.  (The stencil engine is
+        # the only combat engine: at honest bucket sizes it beats the old
+        # per-candidate-gather pipeline even on a single CPU core —
+        # 103 ms vs 186 ms at 100k — and by ~25x on a v5e, where
+        # irregular gathers run at ~1% of HBM bandwidth.)
         self.use_pallas = use_pallas
         # fraction of the population the attacker candidate table is sized
         # for; 1.0 (safe default) means "everyone could fire on one tick".
@@ -327,6 +345,34 @@ class CombatModule(Module):
             self.resolved_bucket(capacity),
         )
 
+    def resolved_engine(self) -> int:
+        """The combat engine this trace will bake in: 0 (XLA fold over
+        split tables), 1 (Pallas fold, same tables) or 2 (fused
+        table-free neighborhood).  `use_pallas` wins when set (bools keep
+        their historical meaning: True == 1); otherwise NF_PALLAS decides.
+        Unknown env values raise instead of silently running the default
+        — a typo'd engine would invalidate any A/B it labeled (same
+        contract as ops.stencil.binning_mode).  The VMEM-budget downgrade
+        for engine 2 happens at the dispatch site, not here — this is the
+        *requested* engine."""
+        mode = self.use_pallas
+        if mode is None:
+            import os
+
+            # nf-lint: disable=trace-safety -- sanctioned A/B knob:
+            # trace-time read baked into the compiled fold; flipping
+            # NF_PALLAS needs a fresh jit cache by design
+            raw = os.environ.get("NF_PALLAS", "").strip()
+            if raw in ("", "0", "1", "2"):
+                return int(raw or "0")
+            raise ValueError(
+                f"NF_PALLAS={raw!r}: expected one of '', '0', '1', '2'"
+            )
+        mode = int(mode)
+        if mode not in (0, 1, 2):
+            raise ValueError(f"use_pallas={mode!r}: expected 0, 1 or 2")
+        return mode
+
     # -- device phases -------------------------------------------------------
 
     def _combat_phase(self, state: WorldState, ctx) -> WorldState:
@@ -357,6 +403,26 @@ class CombatModule(Module):
         n = pos.shape[0]
         bucket = self.resolved_bucket(n)
         att_bucket = self.resolved_att_bucket(n)
+        engine = self.resolved_engine()
+        if engine == 2:
+            from ..ops.stencil_pallas import (
+                fused_fits_vmem,
+                note_fused_fallback,
+            )
+
+            # host-side VMEM gate on the static geometry: an oversize
+            # world (1M-entity bank alone outgrows a core's VMEM) must
+            # fall back to the split-table path, not fail in Mosaic
+            fits, need, budget_b = fused_fits_vmem(
+                n, self.width, bucket, att_bucket
+            )
+            if not fits:
+                note_fused_fallback(
+                    f"{cname}: n={n} width={self.width} "
+                    f"bucket={bucket}/{att_bucket}",
+                    need, budget_b,
+                )
+                engine = 0
         # TWO tables: every alive entity is RESIDENT as a victim (K deep),
         # but only this tick's attackers ride the 9x-scanned candidate
         # side (K_att deep — with staggered attack phases K_att is
@@ -393,56 +459,85 @@ class CombatModule(Module):
             # displacement-gated build (ops/verlet.py): the argsort only
             # runs when some entity drifted >= skin/2 from its binning
             # anchor (or the alive set changed); otherwise both payload
-            # scatters replay against the cached slot assignment.  The
-            # fold below masks by TRUE radius on current positions, so
-            # results stay bit-identical to rebuilding every tick.
+            # scatters (or, on the fused path, just the slot bookkeeping)
+            # replay against the cached slot assignment.  The fold below
+            # masks by TRUE radius on current positions, so results stay
+            # bit-identical to rebuilding every tick.
             aux_key = f"verlet/{cname}"
             cache, rebuilt = refresh(
                 state.aux[aux_key], pos, cs.alive,
                 self.cell_size, self.width, bucket, self.verlet_skin,
             )
             n_cells = self.width * self.width
-            vic_table = full_table(
-                cache, vic_feats, cs.alive, n_cells,
-                self.cell_size, self.width, bucket,
-            )
-            att_table = sub_table(
-                cache, attacking, att_feats, n_cells,
-                self.cell_size, self.width, att_bucket,
-            )
+            if engine == 2:
+                # slots only — the payload tables are never materialized
+                vic_bin = slots_from_assignment(
+                    cs.alive, cache.slot_of, n_cells,
+                    self.cell_size, self.width, bucket,
+                )
+                att_bin = slots_from_assignment(
+                    attacking, sub_slots(cache, attacking, n_cells, att_bucket),
+                    n_cells, self.cell_size, self.width, att_bucket,
+                )
+            else:
+                vic_bin = full_table(
+                    cache, vic_feats, cs.alive, n_cells,
+                    self.cell_size, self.width, bucket,
+                )
+                att_bin = sub_table(
+                    cache, attacking, att_feats, n_cells,
+                    self.cell_size, self.width, att_bucket,
+                )
             ctx.count("grid_rebuilds", rebuilt)
             ctx.count("grid_reuses", 1 - rebuilt)
             ctx.count("grid_cache_age", cache.age)
             state = state.replace(aux={**state.aux, aux_key: cache})
+        elif engine == 2:
+            # one key pass feeds both slot assignments, no payloads
+            vic_bin, att_bin = build_cell_slots_pair(
+                pos, cs.alive, attacking,
+                self.cell_size, self.width, bucket, att_bucket,
+            )
         else:
             # one argsort feeds both tables (attackers subset of alive)
-            vic_table, att_table = build_cell_table_pair(
+            vic_bin, att_bin = build_cell_table_pair(
                 pos, cs.alive, vic_feats, attacking, att_feats,
                 self.cell_size, self.width, bucket, att_bucket,
             )
-        pallas_on = self.use_pallas
-        if pallas_on is None:
-            import os
-
-            # nf-lint: disable=trace-safety -- sanctioned A/B knob:
-            # trace-time read baked into the compiled fold; flipping
-            # NF_PALLAS needs a fresh jit cache by design
-            pallas_on = os.environ.get("NF_PALLAS", "") == "1"
-        if pallas_on:
+        nbr = None
+        if engine == 2:
             import jax
 
-            from ..ops.stencil_pallas import combat_fold_pallas
+            from ..ops.stencil_pallas import fused_neighborhood
 
-            inc, bestr = combat_fold_pallas(
-                vic_table,
-                att_table,
+            # one shared SoA bank serves both sides of the fold; the
+            # attacker row id is the gather index itself
+            bank = jnp.stack(
+                [pos[:, 0], pos[:, 1], camp_f, scene_f, group_f, eff_atk],
+                axis=-1,
+            )
+            inc, bestr, nbr = fused_neighborhood(
+                bank,
+                vic_bin,
+                att_bin,
                 self.radius,
                 # native lowering only on TPU-class backends; anything
                 # else (cpu, gpu, metal) runs the kernel interpreted
                 interpret=jax.default_backend() not in ("tpu", "axon"),
             )
+        elif engine == 1:
+            import jax
+
+            from ..ops.stencil_pallas import combat_fold_pallas
+
+            inc, bestr = combat_fold_pallas(
+                vic_bin,
+                att_bin,
+                self.radius,
+                interpret=jax.default_backend() not in ("tpu", "axon"),
+            )
         else:
-            inc, bestr = combat_fold_xla(vic_table, att_table, self.radius)
+            inc, bestr = combat_fold_xla(vic_bin, att_bin, self.radius)
         if self.emit_events:
             # runtime overflow signal: the duty-sized attacker bucket is
             # baked into the traced tick, so arming patterns that
@@ -451,20 +546,30 @@ class CombatModule(Module):
             # would otherwise drop attacks silently.  Subscribe batch to
             # ON_COMBAT_TABLE_OVERFLOW to observe it; bench.py replays
             # the residue classes offline for the same number.
-            total_drop = vic_table.dropped + att_table.dropped
+            total_drop = vic_bin.dropped + att_bin.dropped
             mask0 = jnp.zeros((n,), bool).at[0].set(total_drop > 0)
             ctx.emit(
                 int(GameEvent.ON_COMBAT_TABLE_OVERFLOW),
                 cname,
                 mask0,
-                dropped_victims=jnp.broadcast_to(vic_table.dropped, (n,)),
-                dropped_attackers=jnp.broadcast_to(att_table.dropped, (n,)),
+                dropped_victims=jnp.broadcast_to(vic_bin.dropped, (n,)),
+                dropped_attackers=jnp.broadcast_to(att_bin.dropped, (n,)),
             )
         # counter bank (rides the summary fetch; always on, unlike the
         # emit_events-gated overflow event above)
-        ctx.count("aoi_victim_overflow_drops", vic_table.dropped)
-        ctx.count("aoi_attacker_overflow_drops", att_table.dropped)
-        pulled = pull(vic_table, jnp.stack([inc, bestr], axis=-1), fill=(0, -1))
+        ctx.count("aoi_victim_overflow_drops", vic_bin.dropped)
+        ctx.count("aoi_attacker_overflow_drops", att_bin.dropped)
+        pulled = pull_slots(
+            vic_bin.slot_of, jnp.stack([inc, bestr], axis=-1), fill=(0, -1)
+        )
+        if nbr is not None:
+            # fused-path bonus output: the AOI/interest occupancy count
+            # per entity (scope per ops.interest.scope_mask, self
+            # excluded) — a counter, not state, so digests stay
+            # bit-identical across engines
+            ctx.count(
+                "aoi_interest_pairs", pull_slots(vic_bin.slot_of, nbr, fill=0)
+            )
         incoming = pulled[..., 0]
         # dead-but-not-yet-respawned victims take no damage
         incoming = jnp.where(cs.alive & (hp > 0), incoming, 0)

@@ -35,6 +35,7 @@ from .rules_contracts import (
 )
 from .rules_determinism import UnseededRngRule, WallClockRule
 from .rules_mesh import MeshNotCapturedRule
+from .rules_pallas import PallasParityPinnedRule
 from .rules_serving import ServeLoopRule
 from .rules_store import MigrateCoversStoreRule
 from .rules_trace import RecompileHazardRule, TraceSafetyRule
@@ -55,6 +56,7 @@ ALL_RULES = (
     ServeLoopRule,
     MigrateCoversStoreRule,
     MeshNotCapturedRule,
+    PallasParityPinnedRule,
 )
 
 RULES_BY_NAME = {cls.name: cls for cls in ALL_RULES}

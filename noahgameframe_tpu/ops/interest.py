@@ -97,6 +97,18 @@ def visible_candidates(
     )
 
 
+def scope_mask(cand_scene, cand_group, obs_scene, obs_group) -> jnp.ndarray:
+    """The reference's broadcast visibility scope (NFCSceneAOIModule):
+    same scene, and either the same group or the candidate carries
+    GroupID 0 (scene-wide wildcard).  All args are broadcastable f32
+    planes.  Shared by the per-observer scan below AND the fused Pallas
+    neighborhood kernel's AOI occupancy fold (ops/stencil_pallas.py) so
+    scope semantics cannot drift between the serving and combat paths."""
+    return (cand_scene == obs_scene) & (
+        (cand_group == 0) | (cand_group == obs_group)
+    )
+
+
 def _interest_feats(pos, scene, group) -> jnp.ndarray:
     """The candidate feature layout both builders share: row id, x, y,
     scene, group (occupancy appended by the table builder)."""
@@ -141,10 +153,12 @@ def _scan_observers(
         dxv = cells[..., 1] - obs_pos[:, None, 0]
         dyv = cells[..., 2] - obs_pos[:, None, 1]
         within = (dxv * dxv + dyv * dyv) <= radius * radius
-        same_scene = cells[..., 3] == obs_scene[:, None]
-        grp_ok = (cells[..., 4] == 0) | (cells[..., 4] == obs_group[:, None])
+        scoped = scope_mask(
+            cells[..., 3], cells[..., 4],
+            obs_scene[:, None], obs_group[:, None],
+        )
         cand_list.append(cells[..., 0].astype(jnp.int32))
-        ok_list.append(occ & within & same_scene & grp_ok)
+        ok_list.append(occ & within & scoped)
     return InterestResult(
         rows=jnp.concatenate(cand_list, axis=1),
         ok=jnp.concatenate(ok_list, axis=1),

@@ -84,6 +84,25 @@ def binning_mode() -> str:
 STENCIL = [(dy, dx) for dy in (-1, 0, 1) for dx in (-1, 0, 1)]
 
 
+class CellSlots(NamedTuple):
+    """A slot assignment WITHOUT the payload materialization.
+
+    The fused Pallas engine (ops/stencil_pallas.py, NF_PALLAS=2) gathers
+    features straight from the SoA banks via these slots, so the padded
+    `[n_cells*K + 1, F+1]` payload table — the biggest per-frame HBM
+    materialization of the split path — is never written.  Same slot
+    semantics as CellTable (dump slot == n_cells*K for unplaced rows,
+    `dropped` counts active overflow), minus the scatter.
+    """
+
+    slot_of: jnp.ndarray
+    dropped: jnp.ndarray
+    width: int
+    cell_size: float
+    bucket: int
+    height: int = -1
+
+
 class CellTable(NamedTuple):
     """Sorted cell-dense payload table.
 
@@ -348,6 +367,91 @@ def _build_pair_counting(
     return full, sub
 
 
+def _slots_from_ranks(
+    n: int, n_cells: int, order, skey, rank, bucket: int
+) -> jnp.ndarray:
+    """SORT-engine slot assignment from sorted segment ranks: un-sort
+    `skey * bucket + rank` back to row order (one scatter).  Shared by
+    _finish_table, the Verlet rebuild (ops/verlet.py) and the slots-only
+    builders below so the placement math cannot drift between the
+    payload and fused engines."""
+    dump = n_cells * bucket
+    placed = (rank < bucket) & (skey < n_cells)
+    flat_sorted = jnp.where(placed, skey * bucket + rank, dump)
+    return jnp.full((n,), dump, jnp.int32).at[order].set(flat_sorted)
+
+
+def slots_from_assignment(
+    active, slot_of, n_cells: int,
+    cell_size: float, width: int, bucket: int, height: int = -1,
+) -> CellSlots:
+    """CellSlots from a precomputed per-row slot array: force inactive
+    rows to the dump slot and count active overflow — exactly the
+    bookkeeping half of table_from_slots, minus the payload scatter."""
+    dump = n_cells * bucket
+    slot_of = jnp.where(active, slot_of, dump)
+    dropped = jnp.sum(active & (slot_of == dump), dtype=jnp.int32)
+    return CellSlots(slot_of, dropped, width, cell_size, bucket, height)
+
+
+def build_cell_slots_pair(
+    pos: jnp.ndarray,
+    active: jnp.ndarray,
+    sub_mask: jnp.ndarray,
+    cell_size: float,
+    width: int,
+    bucket: int,
+    sub_bucket: int,
+    cell: jnp.ndarray | None = None,
+    height: int = -1,
+) -> Tuple[CellSlots, CellSlots]:
+    """build_cell_table_pair minus the payloads: the same NF_BINNING
+    dispatch, key pass, ranks and dump-slot rules, returning only the
+    two slot assignments (full population + subset).  Placement is
+    bit-identical to the table pair — including which rows drop — so
+    the fused engine inherits the split engine's overflow semantics."""
+    n_rows = height if height > 0 else width
+    n = pos.shape[0]
+    mode = binning_mode()
+    if mode == "count":
+        n_cells, key = _cell_keys(
+            pos, active, cell_size, width, cell=cell,
+            n_cells=(n_rows * width if cell is not None else None),
+        )
+        full = slots_from_assignment(
+            active, _counting_slots(key, n_cells, bucket), n_cells,
+            cell_size, width, bucket, height,
+        )
+        sub_key = jnp.where(sub_mask, key, n_cells)
+        sub = slots_from_assignment(
+            sub_mask, _counting_slots(sub_key, n_cells, sub_bucket), n_cells,
+            cell_size, width, sub_bucket, height,
+        )
+        return full, sub
+    if mode != "sort":
+        raise ValueError(f"unhandled binning mode {mode!r}")  # pragma: no cover
+    n_cells, order, skey, seg_start, rank = _sorted_segments(
+        pos, active, cell_size, width, cell=cell,
+        n_cells=(n_rows * width if cell is not None else None),
+    )
+    full = slots_from_assignment(
+        active, _slots_from_ranks(n, n_cells, order, skey, rank, bucket),
+        n_cells, cell_size, width, bucket, height,
+    )
+    # subset ranks via the same segmented exclusive cumsum as the pair
+    # builder (see build_cell_table_pair for the derivation)
+    sub_sorted = sub_mask[order]
+    ex = jnp.cumsum(sub_sorted.astype(jnp.int32)) - sub_sorted.astype(jnp.int32)
+    head_ex = jax.lax.cummax(jnp.where(seg_start, ex, -1))
+    sub_rank = jnp.where(sub_sorted, ex - head_ex, n_cells * sub_bucket + 1)
+    sub = slots_from_assignment(
+        sub_mask,
+        _slots_from_ranks(n, n_cells, order, skey, sub_rank, sub_bucket),
+        n_cells, cell_size, width, sub_bucket, height,
+    )
+    return full, sub
+
+
 def table_from_slots(
     features, active, slot_of, n_cells: int,
     cell_size: float, width: int, bucket: int, height: int = -1,
@@ -385,10 +489,7 @@ def _finish_table(
     sorted-gather + scatter (each N-sized irregular op costs ~1 ms per
     131k rows on a v5e; this is the hot per-tick build)."""
     n = features.shape[0]
-    dump = n_cells * bucket
-    placed = (rank < bucket) & (skey < n_cells)
-    flat_sorted = jnp.where(placed, skey * bucket + rank, dump)
-    slot_of = jnp.full((n,), dump, jnp.int32).at[order].set(flat_sorted)
+    slot_of = _slots_from_ranks(n, n_cells, order, skey, rank, bucket)
     return table_from_slots(
         features, active, slot_of, n_cells, cell_size, width, bucket, height
     )
@@ -517,11 +618,14 @@ def stencil_fold(
     return acc
 
 
-def pull(
-    table: CellTable, values: jnp.ndarray, fill: float | Tuple[float, ...] = 0.0
+def pull_slots(
+    slot_of: jnp.ndarray, values: jnp.ndarray,
+    fill: float | Tuple[float, ...] = 0.0,
 ) -> jnp.ndarray:
     """Map per-slot results [H, W, K] or [H, W, K, V] back to rows [N] /
-    [N, V] with one gather; unplaced rows read `fill`."""
+    [N, V] with one gather through a raw slot array; unplaced rows (dump
+    slot) read `fill`.  The slot-only half of `pull` — the fused engine
+    (CellSlots) has no table to pass."""
     squeeze = values.ndim == 3
     if squeeze:
         values = values[..., None]
@@ -531,5 +635,12 @@ def pull(
         jnp.asarray(fill, values.dtype).reshape(-1), (nv,)
     )
     flat = jnp.concatenate([flat, fill_row[None, :]], axis=0)
-    out = flat[table.slot_of]
+    out = flat[slot_of]
     return out[..., 0] if squeeze else out
+
+
+def pull(
+    table: CellTable, values: jnp.ndarray, fill: float | Tuple[float, ...] = 0.0
+) -> jnp.ndarray:
+    """`pull_slots` through a CellTable's slot assignment."""
+    return pull_slots(table.slot_of, values, fill)

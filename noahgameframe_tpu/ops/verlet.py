@@ -56,7 +56,7 @@ from .stencil import (
     CellTable,
     _cell_keys,
     _counting_slots,
-    _finish_table,
+    _slots_from_ranks,
     _sorted_segments,
     binning_mode,
     table_from_slots,
@@ -204,7 +204,6 @@ def refresh(
         n_cells = width * width
     trig = need_rebuild(cache, pos, active, skin, axis_name=axis_name)
     n = pos.shape[0]
-    dump = n_cells * bucket
     mode = binning_mode()  # trace-time, like the NF_RADIX read below it
 
     def rebuild(_):
@@ -222,9 +221,7 @@ def refresh(
             _nc, order, skey, _seg_start, rank = _sorted_segments(
                 pos, active, cell_size, width, cell=cell, n_cells=n_cells
             )
-            placed = (rank < bucket) & (skey < n_cells)
-            flat_sorted = jnp.where(placed, skey * bucket + rank, dump)
-            slot_of = jnp.full((n,), dump, jnp.int32).at[order].set(flat_sorted)
+            slot_of = _slots_from_ranks(n, n_cells, order, skey, rank, bucket)
         return VerletCache(
             anchor_pos=pos[:, :2].astype(jnp.float32),
             anchor_active=active,
@@ -262,6 +259,33 @@ def full_table(
     )
 
 
+def sub_slots(
+    cache: VerletCache,
+    sub_mask: jnp.ndarray,
+    n_cells: int,
+    sub_bucket: int,
+) -> jnp.ndarray:
+    """The raw subset slot assignment through the cached order — the
+    sort-free core of sub_table, shared with the fused Pallas engine
+    (which gathers from the SoA banks instead of scattering a payload).
+    Returns [N] i32 flat slots (dump == n_cells*sub_bucket for
+    non-members); callers wanting drop counts wrap it in
+    stencil.slots_from_assignment."""
+    if binning_mode() == "count":
+        sub_key = jnp.where(sub_mask, cache.skey, n_cells)
+        return _counting_slots(sub_key, n_cells, sub_bucket)
+    order, skey = cache.order, cache.skey
+    n = order.shape[0]
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), skey[1:] != skey[:-1]]
+    )
+    sub_sorted = sub_mask[order]
+    ex = jnp.cumsum(sub_sorted.astype(jnp.int32)) - sub_sorted.astype(jnp.int32)
+    head_ex = jax.lax.cummax(jnp.where(seg_start, ex, -1))
+    sub_rank = jnp.where(sub_sorted, ex - head_ex, n_cells * sub_bucket + 1)
+    return _slots_from_ranks(n, n_cells, order, skey, sub_rank, sub_bucket)
+
+
 def sub_table(
     cache: VerletCache,
     sub_mask: jnp.ndarray,
@@ -280,22 +304,7 @@ def sub_table(
     `skey` holds per-row anchor keys instead, and the subset re-runs the
     bounded scatter-min selection over them.  Bit-identical to the pair
     builder's sub table for any sub_mask subset of the anchor active set."""
-    if binning_mode() == "count":
-        sub_key = jnp.where(sub_mask, cache.skey, n_cells)
-        sub_slots = _counting_slots(sub_key, n_cells, sub_bucket)
-        return table_from_slots(
-            sub_features, sub_mask, sub_slots, n_cells, cell_size, width,
-            sub_bucket, height,
-        )
-    order, skey = cache.order, cache.skey
-    seg_start = jnp.concatenate(
-        [jnp.ones((1,), bool), skey[1:] != skey[:-1]]
-    )
-    sub_sorted = sub_mask[order]
-    ex = jnp.cumsum(sub_sorted.astype(jnp.int32)) - sub_sorted.astype(jnp.int32)
-    head_ex = jax.lax.cummax(jnp.where(seg_start, ex, -1))
-    sub_rank = jnp.where(sub_sorted, ex - head_ex, n_cells * sub_bucket + 1)
-    return _finish_table(
-        sub_features, sub_mask, n_cells, order, skey, sub_rank,
-        cell_size, width, sub_bucket, height,
+    return table_from_slots(
+        sub_features, sub_mask, sub_slots(cache, sub_mask, n_cells, sub_bucket),
+        n_cells, cell_size, width, sub_bucket, height,
     )

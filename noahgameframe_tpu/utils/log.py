@@ -79,7 +79,9 @@ class LogModule(Module):
             self._logger.addHandler(h)
 
     # -- plain levels ----------------------------------------------------
-    def log(self, level: LogLevel, msg: str, *args) -> None:
+    def log(self, level: int, msg: str, *args) -> None:
+        # level is a LogLevel (IntEnum) — declared int: a host scalar,
+        # never a traced value
         self._logger.log(int(level), msg, *args)
 
     def debug(self, msg: str, *args) -> None:

@@ -1,7 +1,8 @@
 """Promote measured A/B winners into bench_runs/tuning.json.
 
 The harvest queue captures the 1M tick under the default engines and
-under the opt-in variants (NF_RADIX=1/2 sort, NF_PALLAS=1 fold).  This
+under the opt-in variants (NF_RADIX=1/2 sort, NF_PALLAS=1 fold /
+NF_PALLAS=2 fused table-free).  This
 script compares whatever captures exist and records the winning flag
 set, so later bench runs (including the driver's end-of-round one) use
 the fastest measured configuration instead of the defaults.  Env vars
@@ -56,17 +57,35 @@ def main() -> None:
     if best_flag is not None:
         tuning["NF_RADIX"] = best_flag
 
+    # NF_PALLAS tri-state election: 1 (fold-only kernel, plus its lane-
+    # aligned variant) and 2 (fused table-free engine, r11) compete
+    # against the same baseline; the fastest capture past the margin
+    # wins.  Crash-immune like every rule here: a missing/errored
+    # capture is None and simply doesn't compete (a 1M world may land in
+    # the fused engine's VMEM-fallback regime, in which case its capture
+    # ~equals baseline and loses the margin on its own).
     pallas_ms = tick_ms("r05_tpu_1m_pallas.json")
     pallas_al_ms = tick_ms("r05_tpu_1m_pallas_aligned.json")
+    pallas2_ms = tick_ms("r11_tpu_1m_pallas2.json")
     detail["pallas_tick_ms"] = pallas_ms
     detail["pallas_aligned_tick_ms"] = pallas_al_ms
-    best_pallas = min(
-        (ms for ms in (pallas_ms, pallas_al_ms) if ms is not None),
-        default=None,
-    )
-    if best_pallas is not None and best_pallas < base * MARGIN:
-        tuning["NF_PALLAS"] = "1"
-        if best_pallas == pallas_al_ms and pallas_al_ms != pallas_ms:
+    detail["pallas2_tick_ms"] = pallas2_ms
+    candidates = [
+        ("1", pallas_ms),
+        ("1", pallas_al_ms),
+        ("2", pallas2_ms),
+    ]
+    best_mode, best_pallas = None, base * MARGIN
+    for mode, ms in candidates:
+        if ms is not None and ms < best_pallas:
+            best_mode, best_pallas = mode, ms
+    if best_mode is not None:
+        tuning["NF_PALLAS"] = best_mode
+        if (
+            best_mode == "1"
+            and best_pallas == pallas_al_ms
+            and pallas_al_ms != pallas_ms
+        ):
             tuning["NF_PALLAS_ALIGN"] = "128"
 
     # Verlet skin (ops/verlet.py): the harvest queue captures the 1M tick

@@ -297,6 +297,65 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         out["passes"]["fold_pallas"] = f"ERROR {type(e).__name__}: {e}"
 
+    # -- fused table-free engine (NF_PALLAS=2, r11): the slots-only build
+    # and the bank-gathering fused kernel, as separate CostBook entries so
+    # the harvest attributes compile wall + FLOPs/bytes per variant from
+    # the same ledger the split passes use ------------------------------------
+    try:
+        from noahgameframe_tpu.ops.stencil import (
+            CellSlots,
+            build_cell_slots_pair,
+        )
+        from noahgameframe_tpu.ops.stencil_pallas import (
+            fused_fits_vmem,
+            fused_neighborhood,
+        )
+
+        interp = jax.default_backend() not in ("tpu", "axon")
+        fits, need, budget = fused_fits_vmem(cap, width, bucket, att_bucket)
+        out["pallas2_vmem"] = {
+            "fits": bool(fits), "need_bytes": int(need),
+            "budget_bytes": int(budget),
+        }
+        slots_pair = wrap(
+            "pallas2_slots_pair",
+            lambda p, al, am: build_cell_slots_pair(
+                p, al, am, cell_size, width, bucket, att_bucket
+            ),
+        )
+        timed("pallas2_slots_pair", slots_pair, pos, alive, attacking)
+        vic_slots, att_slots = jax.block_until_ready(
+            slots_pair(pos, alive, attacking)
+        )
+        bank = jnp.stack(
+            [pos[:, 0], pos[:, 1], camp_f, scene_f, group_f, atk_f], -1
+        )
+
+        def mk_slots(so, kk):
+            return CellSlots(so, jnp.int32(0), width, cell_size, kk)
+
+        if fits:
+            fname = "pallas2_fused" + ("_interpret" if interp else "")
+            timed(
+                fname,
+                wrap(
+                    fname,
+                    lambda bk, vso, aso: fused_neighborhood(
+                        bk, mk_slots(vso, bucket), mk_slots(aso, att_bucket),
+                        combat.radius, interpret=interp,
+                    ),
+                ),
+                bank, vic_slots.slot_of, att_slots.slot_of,
+            )
+        else:
+            # the engine dispatch would downgrade here — record the
+            # regime instead of timing a kernel production never runs
+            out["passes"]["pallas2_fused"] = (
+                f"VMEM_FALLBACK need={need} budget={budget}"
+            )
+    except Exception as e:  # noqa: BLE001
+        out["passes"]["pallas2_fused"] = f"ERROR {type(e).__name__}: {e}"
+
     # compile/cost ledger for the whole pass list — same schema as the
     # /costbook route, so pass profiles and BENCH detail join on entry
     out["costbook"] = book.snapshot()

@@ -142,6 +142,17 @@ while :; do
   run_item b1m_pallas_al 1800 env NF_PALLAS=1 NF_PALLAS_ALIGN=128 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
     && save_json b1m_pallas_al bench_runs/r05_tpu_1m_pallas_aligned.json
 
+  # 5d. fused table-free neighborhood engine A/B (NF_PALLAS=2, r11): the
+  #     100k shape fits the per-core VMEM budget outright; the 1M shape
+  #     documents whichever regime the chip exposes — fused if the bank
+  #     fits, or the sanctioned fallback (~baseline tick + a nonzero
+  #     nf_pallas_fallback_total in the capture's metrics).  Either way
+  #     decide_tuning only promotes a measured win past the margin.
+  run_item b100k_pallas2 900 env NF_PALLAS=2 python -u bench.py --entities 100000 --ticks 90 --platform tpu \
+    && save_json b100k_pallas2 bench_runs/r11_tpu_100k_pallas2.json
+  run_item b1m_pallas2 1800 env NF_PALLAS=2 python -u bench.py --entities 1000000 --ticks 90 --platform tpu \
+    && save_json b1m_pallas2 bench_runs/r11_tpu_1m_pallas2.json
+
   # 5c. round-6 baseline + Verlet-skin A/B at 1M (ops/verlet.py): the
   #     skin trades argsort rate against bucket inflation, so the winner
   #     is elected from measurement (decide_tuning.py -> NF_VERLET_SKIN)
@@ -193,7 +204,7 @@ while :; do
   fi
 
   n_done=$(ls "$STAMPS" | wc -l)
-  if [ "$n_done" -ge 23 ]; then
+  if [ "$n_done" -ge 25 ]; then
     echo "[$(date -u +%H:%M:%S)] queue drained — exiting"
     exit 0
   fi

@@ -1,0 +1,20 @@
+"""pallas-parity-pinned violations: a jit-reachable pallas_call whose
+enclosing function the registry never names, plus a stale registry key
+whose kernel vanished."""
+import jax
+from jax.experimental import pallas as pl
+
+PALLAS_PARITY_TESTS = {
+    "vanished_fold": "kernel/parity_pin.py",  # stale: kernel is gone
+}
+
+
+def _body(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def fused_fold(x):  # reachable pallas_call, but not in the registry
+    return pl.pallas_call(_body, out_shape=x)(x)
+
+
+fold = jax.jit(fused_fold)

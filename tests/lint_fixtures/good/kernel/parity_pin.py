@@ -1,0 +1,7 @@
+"""Interpret-mode parity pin for the fixture's fused_fold kernel: the
+CPU CI path runs fused_fold with interpret=True and compares against
+the reference fold bit-for-bit.  (Fixture stand-in for a real test
+module — the rule checks the pin's text names the kernel and the
+interpret mode.)"""
+
+PINNED = {"fused_fold": "interpret=True parity vs reference fold"}

@@ -75,6 +75,263 @@ def test_pallas_fold_matches_xla_fold_asymmetric_buckets():
     )
 
 
+# ------------------------------------------------ fused engine (NF_PALLAS=2)
+
+
+def _combat_arrays(n, seed, width=6, cell_size=5.0, clump=None):
+    """Random combat-shaped population; clump=(x0, x1) squeezes every
+    position into that interval on both axes (siege shapes)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    extent = width * cell_size
+    lo, hi = clump if clump is not None else (0.0, extent)
+    pos = rng.uniform(lo, hi, (n, 2)).astype(np.float32)
+    active = rng.rand(n) < 0.9
+    attacking = (rng.rand(n) < 0.5) & active
+    atk = rng.randint(0, 30, n).astype(np.float32)
+    camp = rng.randint(1, 3, n).astype(np.float32)
+    scene = rng.randint(1, 3, n).astype(np.float32)
+    group = rng.randint(0, 2, n).astype(np.float32)
+    eff = np.where(attacking, atk, 0.0).astype(np.float32)
+    rows = np.arange(n, dtype=np.float32)
+    vic_feats = jnp.asarray(
+        np.stack([pos[:, 0], pos[:, 1], camp, scene, group], -1)
+    )
+    att_feats = jnp.asarray(
+        np.stack([pos[:, 0], pos[:, 1], eff, camp, scene, group, rows], -1)
+    )
+    bank = jnp.asarray(
+        np.stack([pos[:, 0], pos[:, 1], camp, scene, group, eff], -1)
+    )
+    return (
+        jnp.asarray(pos), jnp.asarray(active), jnp.asarray(attacking),
+        vic_feats, att_feats, bank,
+    )
+
+
+def _fused_vs_split(n, seed, bucket, sub_bucket, width=6, cell_size=5.0,
+                    clump=None, radius=5.0):
+    """Run both engines in interpret mode on CPU over the same random
+    population and return everything a parity assert needs."""
+    from noahgameframe_tpu.ops.stencil import (
+        build_cell_slots_pair,
+        build_cell_table_pair,
+    )
+    from noahgameframe_tpu.ops.stencil_pallas import (
+        combat_fold_pallas,
+        fused_neighborhood,
+    )
+
+    pos, active, attacking, vic_feats, att_feats, bank = _combat_arrays(
+        n, seed, width, cell_size, clump
+    )
+    vt, at = build_cell_table_pair(
+        pos, active, vic_feats, attacking, att_feats,
+        cell_size, width, bucket, sub_bucket,
+    )
+    inc0, bestr0 = combat_fold_pallas(vt, at, radius, interpret=True)
+    vs, ats = build_cell_slots_pair(
+        pos, active, attacking, cell_size, width, bucket, sub_bucket
+    )
+    inc1, bestr1, nbr1 = fused_neighborhood(
+        bank, vs, ats, radius, interpret=True
+    )
+    return (vt, at, inc0, bestr0), (vs, ats, inc1, bestr1, nbr1)
+
+
+@pytest.mark.parametrize("binning", ["sort", "count"])
+@pytest.mark.parametrize("seed", [3, 11])
+def test_fused_interpret_parity(monkeypatch, binning, seed):
+    """fused_neighborhood (interpret mode, CPU) is bit-identical to
+    combat_fold_pallas over the split tables — same slot assignment,
+    same stencil order, same tie-breaks — under both binning engines."""
+    monkeypatch.setenv("NF_BINNING", binning)
+    split, fused = _fused_vs_split(300, seed, bucket=16, sub_bucket=12)
+    vt, at, inc0, bestr0 = split
+    vs, ats, inc1, bestr1, _nbr = fused
+    np.testing.assert_array_equal(np.asarray(vt.slot_of), np.asarray(vs.slot_of))
+    np.testing.assert_array_equal(np.asarray(at.slot_of), np.asarray(ats.slot_of))
+    np.testing.assert_array_equal(np.asarray(inc0), np.asarray(inc1))
+    np.testing.assert_array_equal(np.asarray(bestr0), np.asarray(bestr1))
+
+
+@pytest.mark.parametrize("binning", ["sort", "count"])
+def test_fused_aoi_count_matches_brute_force(monkeypatch, binning):
+    """The fused kernel's AOI occupancy plane equals a brute-force
+    per-victim neighbor count (interest scope, self excluded) over the
+    entities the table actually placed."""
+    import jax.numpy as jnp
+
+    from noahgameframe_tpu.ops.stencil import pull_slots
+
+    monkeypatch.setenv("NF_BINNING", binning)
+    width, cell_size, radius = 6, 5.0, 5.0
+    n = 300
+    pos, active, attacking, vic_feats, _af, bank = _combat_arrays(n, 7)
+    _split, fused = _fused_vs_split(n, 7, bucket=16, sub_bucket=12)
+    vs = fused[0]
+    nbr = fused[4]
+    nbr_rows = np.asarray(pull_slots(vs.slot_of, nbr, fill=0))
+    posn = np.asarray(pos)
+    scene = np.asarray(vic_feats[:, 3])
+    group = np.asarray(vic_feats[:, 4])
+    placed = np.asarray(vs.slot_of) < width * width * 16
+    for i in np.flatnonzero(placed):
+        d2 = ((posn[placed] - posn[i]) ** 2).sum(-1)
+        scoped = (scene[placed] == scene[i]) & (
+            (group[placed] == 0) | (group[placed] == group[i])
+        )
+        rows = np.arange(n)[placed]
+        want = int(((d2 <= radius * radius) & scoped & (rows != i)).sum())
+        assert nbr_rows[i] == want, i
+
+
+@pytest.mark.parametrize("binning", ["sort", "count"])
+def test_fused_siege_one_cell(monkeypatch, binning):
+    """Degenerate occupancy: the whole population inside ONE cell, far
+    over bucket capacity — overflow drops and fold results must match
+    the split engine exactly (ROADMAP item 5b's siege shape)."""
+    monkeypatch.setenv("NF_BINNING", binning)
+    split, fused = _fused_vs_split(
+        200, 13, bucket=8, sub_bucket=8, clump=(0.5, 4.5)
+    )
+    vt, at, inc0, bestr0 = split
+    vs, ats, inc1, bestr1, _nbr = fused
+    assert int(vs.dropped) == int(vt.dropped) > 0
+    assert int(ats.dropped) == int(at.dropped)
+    np.testing.assert_array_equal(np.asarray(vt.slot_of), np.asarray(vs.slot_of))
+    np.testing.assert_array_equal(np.asarray(inc0), np.asarray(inc1))
+    np.testing.assert_array_equal(np.asarray(bestr0), np.asarray(bestr1))
+
+
+@pytest.mark.parametrize("binning", ["sort", "count"])
+def test_fused_overflow_drop_parity(monkeypatch, binning):
+    """Moderate overflow (small buckets, random spread): which rows drop
+    is part of the engine contract — the fused path must inherit the
+    split path's drops bit-for-bit, not just approximately."""
+    monkeypatch.setenv("NF_BINNING", binning)
+    split, fused = _fused_vs_split(400, 17, bucket=4, sub_bucket=4)
+    vt, at, inc0, bestr0 = split
+    vs, ats, inc1, bestr1, _nbr = fused
+    assert int(vt.dropped) > 0
+    assert int(vs.dropped) == int(vt.dropped)
+    assert int(ats.dropped) == int(at.dropped)
+    np.testing.assert_array_equal(np.asarray(inc0), np.asarray(inc1))
+    np.testing.assert_array_equal(np.asarray(bestr0), np.asarray(bestr1))
+
+
+def _digest_stream(use_pallas, ticks, n=200, seed=3):
+    w = build(n, seed, use_pallas=use_pallas)
+    k = w.kernel
+    k.enable_digest()
+    out = []
+    for _ in range(ticks):
+        k.tick()
+        out.append(int(k.last_counters["state_digest"]) & 0xFFFFFFFF)
+    return out
+
+
+def _digest_after(use_pallas, ticks, n=200, seed=3):
+    w = build(n, seed, use_pallas=use_pallas)
+    k = w.kernel
+    k.enable_digest()
+    k.run_device(ticks)
+    k.tick()
+    return int(k.last_counters["state_digest"]) & 0xFFFFFFFF
+
+
+def test_engine_digest_parity_24():
+    """24 churn ticks: the world ends in the EXACT same state under all
+    three engines (0 = XLA fold, 1 = Pallas fold, 2 = fused table-free)."""
+    d0 = _digest_after(0, 24)
+    d1 = _digest_after(1, 24)
+    d2 = _digest_after(2, 24)
+    assert d0 == d1 == d2
+
+
+@pytest.mark.slow
+def test_engine_digest_parity_120():
+    d0 = _digest_after(0, 120)
+    d1 = _digest_after(1, 120)
+    d2 = _digest_after(2, 120)
+    assert d0 == d1 == d2
+
+
+def test_fused_replay_digest_stream_clean():
+    """Per-tick digest STREAMS (not just the end state) are identical
+    with the engine knob flipped — a replay of the same seed under
+    NF_PALLAS=2 stays digest-clean at every tick."""
+    assert _digest_stream(0, 12) == _digest_stream(2, 12)
+
+
+def test_fused_vmem_fallback(monkeypatch):
+    """A VMEM budget the tile can't fit downgrades engine 2 to the
+    split path at trace time — same results, fallback metric bumped,
+    no failure."""
+    from noahgameframe_tpu.ops import stencil_pallas as sp
+
+    ref = _digest_after(0, 12)
+    monkeypatch.setenv("NF_PALLAS_VMEM_MB", "0.01")
+    before = sp.fused_fallback_total()
+    got = _digest_after(2, 12)
+    assert got == ref
+    assert sp.fused_fallback_total() > before
+    fits, need, budget = sp.fused_fits_vmem(256, 8, 12, 12)
+    assert not fits and need > budget
+
+
+def test_fused_vmem_estimate_sane():
+    """The host-side footprint model: a 20k world fits the default
+    budget, a 1M-entity bank alone does not (the documented fallback
+    regime for the unsharded big bench)."""
+    from noahgameframe_tpu.ops.stencil_pallas import fused_fits_vmem
+
+    fits_small, need_small, _ = fused_fits_vmem(20_000, 32, 36, 36)
+    assert fits_small, need_small
+    fits_big, need_big, _ = fused_fits_vmem(1_000_000, 395, 12, 6)
+    assert not fits_big and need_big > need_small
+
+
+def test_fused_soak_unexplained_clean():
+    """Flipping the engine mid-run is a SANCTIONED retrace: the flip
+    rides kernel.invalidate()'s generation bump, so the CostBook soak
+    gate stays empty over the fused window."""
+    w = build(150, 5, use_pallas=0)
+    k = w.kernel
+    k.enable_digest()
+    k.run_device(6)
+    mark = k.costbook.mark()
+    w.combat.use_pallas = 2
+    k.invalidate()  # engine choice is baked into the trace
+    k.run_device(12)
+    k.tick()
+    assert k.costbook.unexplained_since(mark) == []
+
+
+def test_resolved_engine_validation(monkeypatch):
+    """Tri-state parsing: bools keep their historical meaning, unknown
+    env values raise instead of silently running the default."""
+    w = build(8, 1, use_pallas=None)
+    c = w.combat
+    for env, want in (("", 0), ("0", 0), ("1", 1), ("2", 2)):
+        monkeypatch.setenv("NF_PALLAS", env)
+        assert c.resolved_engine() == want
+    monkeypatch.delenv("NF_PALLAS")
+    assert c.resolved_engine() == 0
+    monkeypatch.setenv("NF_PALLAS", "fused")
+    with pytest.raises(ValueError):
+        c.resolved_engine()
+    monkeypatch.delenv("NF_PALLAS")
+    c.use_pallas = True
+    assert c.resolved_engine() == 1
+    c.use_pallas = False
+    assert c.resolved_engine() == 0
+    c.use_pallas = 3
+    with pytest.raises(ValueError):
+        c.resolved_engine()
+
+
 def test_pallas_fold_lane_aligned_matches(monkeypatch):
     """NF_PALLAS_ALIGN pads the lane (W) axis with zero-occupancy ghost
     cells for TPU lane alignment — results must stay bit-identical to

@@ -69,6 +69,50 @@ def test_aligned_pallas_promotes_align_flag(tmp_path, capsys):
     assert got["env"]["NF_PALLAS_ALIGN"] == "128"
 
 
+def test_fused_pallas2_elected_when_fastest(tmp_path, capsys):
+    """The r11 tri-state: the fused engine's capture beats both the
+    baseline margin and the fold-only variants -> NF_PALLAS=2, and no
+    ALIGN flag rides along (it belongs to the fold-only kernel)."""
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r05_tpu_1m_pallas.json", 90.0)
+    _w(tmp_path, "r05_tpu_1m_pallas_aligned.json", 85.0)
+    _w(tmp_path, "r11_tpu_1m_pallas2.json", 70.0)
+    got = _run(mod, capsys)
+    assert got["env"]["NF_PALLAS"] == "2"
+    assert "NF_PALLAS_ALIGN" not in got["env"]
+    assert got["detail"]["pallas2_tick_ms"] == 70.0
+
+
+def test_fused_pallas2_loses_to_faster_fold(tmp_path, capsys):
+    """Fold-only still wins when it measures faster (e.g. a 1M world in
+    the fused engine's VMEM-fallback regime measures ~baseline)."""
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r05_tpu_1m_pallas.json", 80.0)
+    _w(tmp_path, "r11_tpu_1m_pallas2.json", 99.5)  # fallback regime
+    got = _run(mod, capsys)
+    assert got["env"]["NF_PALLAS"] == "1"
+
+
+def test_fused_pallas2_crash_capture_not_elected(tmp_path, capsys):
+    """Crash-immunity, same contract as the NF_BINNING rules: an error
+    payload (however fast its phantom tick_ms) never elects the engine."""
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r11_tpu_1m_pallas2.json", 5.0, error="mosaic OOM")
+    got = _run(mod, capsys)
+    assert "NF_PALLAS" not in got["env"]
+
+
+def test_fused_pallas2_within_margin_keeps_default(tmp_path, capsys):
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r11_tpu_1m_pallas2.json", 98.0)  # within 3%: tie -> off
+    got = _run(mod, capsys)
+    assert "NF_PALLAS" not in got["env"]
+
+
 def test_verlet_skin_best_variant_wins(tmp_path, capsys):
     mod = _load(tmp_path)
     _w(tmp_path, "r05_tpu_1m.json", 100.0)
