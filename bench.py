@@ -996,6 +996,151 @@ def run_reshard(args) -> dict:
     }
 
 
+def run_rooms(args) -> dict:
+    """ISSUE 19 r12 evidence: the many-worlds rooms ladder.  Each rung
+    admits R independent rooms into ONE vmapped RoomBatch sharded
+    room-major over the mesh — one recipe world built per rung, packed
+    once, admitted R times with per-room rng variation, so setup stays
+    O(1) worlds.  Reported per rung: admit cost, per-batch-tick p50/p99
+    (tick() with the per-room counter-bank fetch — the served-path
+    honest frame), fused room-ticks/sec, then a re-home churn phase
+    with a zero-dropped-rows account and the same CostBook
+    zero-unexplained-recompile gate as the migration ladders."""
+    from noahgameframe_tpu.utils.platform import force_cpu
+
+    if args.platform == "tpu":
+        import jax
+    else:
+        jax = force_cpu(args.rooms)
+
+    import numpy as np
+
+    from noahgameframe_tpu.game import GameWorld
+    from noahgameframe_tpu.game.world import WorldConfig
+    from noahgameframe_tpu.parallel.mesh import ROOMS_AXIS, make_mesh
+    from noahgameframe_tpu.parallel.rooms import RoomBatch, RoomBinPacker
+
+    counts = [int(x) for x in (args.rooms_count or "16,64,256").split(",")]
+    per_room = int(args.rooms_entities)
+    seeded = max(1, per_room // 2)
+    ticks = int(args.rooms_ticks)
+    mesh = make_mesh(args.rooms, axis=ROOMS_AXIS)
+
+    def point(n_rooms):
+        if n_rooms % args.rooms:
+            raise ValueError(f"--rooms-count {n_rooms} not divisible by "
+                             f"the {args.rooms}-device rooms mesh")
+        t0 = time.perf_counter()
+        w = GameWorld(WorldConfig(
+            npc_capacity=per_room, player_capacity=8, extent=64.0,
+            seed=args.seed, middleware=False, combat=True,
+            movement=True, regen=True, verlet_skin=2.0))
+        w.start()
+        w.scene.create_scene(1, width=64.0)
+        w.seed_npcs(seeded, rng=np.random.default_rng(args.seed + 100))
+        w.kernel._ensure_aux()
+        batch = RoomBatch(w.kernel, n_rooms, mesh=mesh)
+        packer = RoomBinPacker(batch.capacity,
+                               n_blocks=mesh.devices.size)
+        build_s = time.perf_counter() - t0
+
+        def room_of(i):
+            return w.kernel.state.replace(
+                rng=jax.random.PRNGKey(args.seed + i))
+
+        # warm-up compiles every entry once (admit/step/run/extract),
+        # then the no-recompile gate arms: churn after the mark must be
+        # free (slot indices are traced scalars)
+        batch.admit(packer.alloc(), room_of(0))
+        batch.tick()
+        batch.run(1)
+        batch.extract(0)
+        batch.rehome(0, 1)
+        packer.free(0)
+        mark = batch.costbook.mark()
+
+        # fill every lane but one — the spare slot is what the churn
+        # phase rotates rooms through
+        t0 = time.perf_counter()
+        used = []
+        while packer.free_count > 1:
+            slot = packer.alloc()
+            batch.admit(slot, room_of(len(used)))
+            used.append(slot)
+        jax.block_until_ready(batch.state)
+        admit_s = time.perf_counter() - t0
+
+        # per-frame latency: tick() includes the [R,L] counter fetch
+        lat = []
+        for _ in range(ticks):
+            t0 = time.perf_counter()
+            counters = batch.tick()
+            lat.append(time.perf_counter() - t0)
+        lat_ms = np.sort(np.asarray(lat)) * 1e3
+        p50 = float(lat_ms[len(lat_ms) // 2])
+        p99 = float(lat_ms[min(len(lat_ms) - 1,
+                               int(len(lat_ms) * 0.99))])
+
+        # fused throughput: one dispatch, zero host syncs inside
+        t0 = time.perf_counter()
+        batch.run(2 * ticks)
+        jax.block_until_ready(batch.state)
+        run_s = time.perf_counter() - t0
+        room_ticks = n_rooms * 2 * ticks / run_s
+
+        # churn: rotate rooms through the spare slot, nothing may drop
+        def rows():
+            return int(np.asarray(
+                batch.state.classes["NPC"].alive)[used].sum())
+
+        before = rows()
+        rng = np.random.default_rng(args.seed)
+        for _ in range(int(args.rooms_churn)):
+            src = used.pop(int(rng.integers(0, len(used))))
+            dst = packer.alloc()
+            batch.rehome(src, dst)
+            packer.free(src)
+            used.append(dst)
+        dropped = before - rows()
+        unexplained = batch.costbook.unexplained_since(mark)
+        return {
+            "rooms": n_rooms,
+            "rooms_admitted": len(used),
+            "entities_per_room": seeded,
+            "build_wall_s": round(build_s, 2),
+            "admit_wall_s": round(admit_s, 2),
+            "admit_ms_per_room": round(admit_s * 1e3 / n_rooms, 3),
+            "tick_p50_ms": round(p50, 3),
+            "tick_p99_ms": round(p99, 3),
+            "room_ticks_per_sec": round(room_ticks, 1),
+            "entity_ticks_per_sec": round(room_ticks * seeded, 1),
+            "counters_sample": {k: int(np.asarray(v).sum())
+                                for k, v in counters.items()},
+            "rehomed": int(args.rooms_churn),
+            "dropped_rows": int(dropped),
+            "unexplained_recompiles": len(unexplained),
+            "costbook": _costbook_detail(batch.costbook),
+        }
+
+    points = [point(n) for n in counts]
+    head = points[-1]
+    return {
+        "metric": "rooms_room_ticks_per_sec",
+        "value": head["room_ticks_per_sec"],
+        "unit": "room-ticks/s",
+        "detail": {
+            "devices": args.rooms,
+            "seed": args.seed,
+            "platform": jax.devices()[0].platform,
+            "ticks_timed": int(args.rooms_ticks),
+            "all_gates": all(
+                p["dropped_rows"] == 0
+                and p["unexplained_recompiles"] == 0 for p in points),
+            "points": points,
+        },
+    }
+
+
 def run_bench(args) -> dict:
     import jax
 
@@ -1522,6 +1667,33 @@ def main() -> None:
              "budget knobs reuse --mig-entities/--mig-budgets",
     )
     ap.add_argument(
+        "--rooms", type=int, default=0, metavar="N",
+        help="many-worlds rooms ladder over an N-device room-major "
+             "mesh (virtual CPU devices, or the real chips with "
+             "--platform tpu): R independent rooms vmapped as one "
+             "batch, per-batch-tick p50/p99, fused room-ticks/sec, and "
+             "a re-home churn phase gated on zero dropped rows + zero "
+             "unexplained recompiles (r12 evidence)",
+    )
+    ap.add_argument(
+        "--rooms-count", default=None, metavar="R,R,...",
+        help="rooms ladder rungs (default 16,64,256; each must divide "
+             "by --rooms)",
+    )
+    ap.add_argument(
+        "--rooms-entities", type=int, default=64,
+        help="per-room NPC capacity (half of it seeded live)",
+    )
+    ap.add_argument(
+        "--rooms-churn", type=int, default=8,
+        help="re-homes rotated through the spare slot per rung",
+    )
+    ap.add_argument(
+        "--rooms-ticks", type=int, default=30,
+        help="individually-timed batch ticks per rung (the fused "
+             "throughput window runs 2x this)",
+    )
+    ap.add_argument(
         "--mig-entities", default=None, metavar="N,N,...",
         help="mesh-migrate entity ladder (default 100000,1000000; the "
              "full knob product runs at the smallest count only)",
@@ -1604,6 +1776,26 @@ def main() -> None:
                     "metric": "reshard_drain_exodus_ticks",
                     "value": 0,
                     "unit": "ticks",
+                    "error": f"{type(e).__name__}: {e}",
+                    "detail": {
+                        "trace_tail": traceback.format_exc().strip()
+                        .splitlines()[-4:],
+                    },
+                }
+            )
+        return
+
+    if args.rooms:
+        try:
+            _emit(run_rooms(args))
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            _emit(
+                {
+                    "metric": "rooms_room_ticks_per_sec",
+                    "value": 0.0,
+                    "unit": "room-ticks/s",
                     "error": f"{type(e).__name__}: {e}",
                     "detail": {
                         "trace_tail": traceback.format_exc().strip()

@@ -38,6 +38,7 @@ from .invariants import (
     MonotoneWatermarks,
     NoSilentDrop,
     OrderedReplay,
+    RoomIsolation,
     StableUnderReshard,
     default_invariants,
 )
@@ -57,6 +58,7 @@ __all__ = [
     "MonotoneWatermarks",
     "NoSilentDrop",
     "OrderedReplay",
+    "RoomIsolation",
     "StableUnderReshard",
     "Step",
     "Violation",

@@ -29,6 +29,14 @@ The library (ISSUE 11 tentpole):
   session-count identity uses the pending gauge; busy is separately
   required to be monotone.)
 
+Later issues extend the library in place:
+
+- :class:`StableUnderReshard` — the elastic mesh never drops a row and
+  stays digest-identical to a static-mesh control (ISSUE 17).
+- :class:`RoomIsolation` — in the many-worlds room engine, a room's
+  digest moves only in lockstep with its own isolated control world;
+  faults in room j never perturb room i (ISSUE 19).
+
 Checkers read cluster state defensively (``getattr`` with fallbacks) so
 violation tests can feed them minimal forged stand-ins.
 
@@ -372,6 +380,55 @@ class StableUnderReshard(Invariant):
                             f"{name}: canonical digest diverged from "
                             f"static-mesh control at tick {tick}: "
                             f"{live:#x} != {want:#x}")
+        return out
+
+
+class RoomIsolation(Invariant):
+    """No cross-room reads in the many-worlds engine (ISSUE 19).
+
+    For every game role hosting a :class:`~..parallel.rooms.
+    RoomDirectory` (read defensively — room-less games are skipped),
+    every room with an attached lockstep CONTROL world must digest
+    bit-identically to it.  Faults injected into room j — kills, store
+    outages, churn, even hostile writes — may change room j, but a
+    watched room i's digest can only move in lockstep with its own
+    isolated control; any divergence is a cross-room read/write.
+
+    Digesting is a host-side fold over an extracted room, so
+    ``sample_every`` bounds the cost: rooms are checked on drill ticks
+    where ``tick % sample_every == 0`` and only when the batch actually
+    advanced since the last check."""
+
+    name = "room_isolation"
+
+    def __init__(self, sample_every: int = 1) -> None:
+        self.sample_every = max(1, int(sample_every))
+        self._last_batch_tick: Dict[str, int] = {}
+
+    def check(self, ctx: DrillContext) -> List[str]:
+        out: List[str] = []
+        if ctx.tick % self.sample_every:
+            return out
+        for game in list(getattr(ctx.cluster, "games", ())):
+            rooms = getattr(game, "rooms", None)
+            if rooms is None or not getattr(rooms, "controls", None):
+                continue
+            name = getattr(getattr(game, "config", None), "name", "game")
+            batch_tick = int(getattr(getattr(rooms, "batch", None),
+                                     "tick_count", 0))
+            if self._last_batch_tick.get(name) == batch_tick:
+                continue  # no frames since last sample; digests can't move
+            self._last_batch_tick[name] = batch_tick
+            for room_id in sorted(rooms.controls):
+                if room_id not in getattr(rooms, "rooms", {}):
+                    continue  # control outlived the room (destroy raced)
+                live = int(rooms.digest(room_id))
+                want = int(rooms.control_digest(room_id))
+                if live != want:
+                    out.append(
+                        f"{name}: room {room_id} diverged from its "
+                        f"isolated control at batch tick {batch_tick}: "
+                        f"{live:#x} != {want:#x} — cross-room leak")
         return out
 
 

@@ -145,6 +145,20 @@ class DrillRunner:
             role = next(r for r in cluster.roles
                         if r.config.name == kw["role"])
             role.drain_device(int(kw["device"]))
+        elif step.action == "create_room":
+            role = next(r for r in cluster.roles
+                        if r.config.name == kw["role"])
+            role.create_room(seed=kw.get("seed"),
+                             room_id=kw.get("room_id"),
+                             control=bool(kw.get("control", False)))
+        elif step.action == "destroy_room":
+            role = next(r for r in cluster.roles
+                        if r.config.name == kw["role"])
+            role.destroy_room(int(kw["room_id"]))
+        elif step.action == "rehome_room":
+            role = next(r for r in cluster.roles
+                        if r.config.name == kw["role"])
+            role.rehome_room(int(kw["room_id"]))
         elif step.action == "call":
             kw["fn"](self)
         # "note" is a pure marker — the fired log below is its effect

@@ -20,6 +20,10 @@ Built-in actions (resolved by the runner against its cluster):
 ``grow_mesh``      ``role=<config name>, n=<devices>`` → ``role.grow_mesh``
 ``drain_device``   ``role=<config name>, device=<index>`` →
                    ``role.drain_device``
+``create_room``    ``role=<config name>, seed=, room_id=, control=`` →
+                   ``role.create_room`` (many-worlds engine)
+``destroy_room``   ``role=<config name>, room_id=`` → ``role.destroy_room``
+``rehome_room``    ``role=<config name>, room_id=`` → ``role.rehome_room``
 ``call``           ``fn=<callable(runner)>`` — surge traffic, asserts, …
 ``note``           no-op marker; lands in the report's action log
 =================  ====================================================
@@ -45,6 +49,9 @@ BUILTIN_ACTIONS = (
     "checkpoint",
     "grow_mesh",
     "drain_device",
+    "create_room",
+    "destroy_room",
+    "rehome_room",
     "call",
     "note",
 )

@@ -129,6 +129,13 @@ class TickCtx:
         # named int32 scalars accumulated on device across phases; the
         # kernel packs them into the per-tick summary fetch (counter bank)
         self._counters: Dict[str, jnp.ndarray] = {}
+        # rooms sharing this trace: 1 for an ordinary world, R when the
+        # kernel is a room-batch template (the step is vmapped, so every
+        # traced value a phase sees is ONE room's slice; this is static
+        # trace-time metadata for phases that size host mirrors)
+        self.room_count = (
+            1 if kernel.room_batch is None else kernel.room_batch.capacity
+        )
 
     def fired(self, class_name: str, timer_name: str) -> jnp.ndarray:
         """[C] bool — which entities' `timer_name` fired this tick."""
@@ -270,6 +277,11 @@ class Kernel(Module):
         # optional telemetry.SpanTracer for host-side tick stage spans
         # (dispatch / summary fetch / post-tick fan-out); None = no cost
         self.tracer = None
+        # back-pointer set by parallel/rooms.RoomBatch.attach() when this
+        # kernel is the TEMPLATE for a room-batched world: its _trace_step
+        # is vmapped over a leading [R] room axis and its own state/jit
+        # entries go unused.  None for every ordinary single-world kernel.
+        self.room_batch = None
         # honest per-stage timing (NF_STAGE_TIMING=1, set by GameRole /
         # telemetry/pipeline.stage_timing_enabled): block after dispatch
         # so the kernel.dispatch span measures device time, not async
@@ -585,10 +597,10 @@ class Kernel(Module):
             summary = np.asarray(raw["summary"])
         # decode the counter bank from the summary tail (names captured at
         # trace time, same static-metadata contract as _event_meta)
-        names = self._counter_names
-        if names:
-            tail = summary[len(summary) - len(names):]
-            out.counters = {k: int(v) for k, v in zip(names, tail)}
+        if self._counter_names:
+            out.counters = {
+                k: int(v) for k, v in self.decode_counters(summary).items()
+            }
             self.last_counters = dict(out.counters)
             for k, v in out.counters.items():
                 if k == "state_digest":
@@ -597,6 +609,22 @@ class Kernel(Module):
         with self._span("kernel.post_tick"):
             self._post_tick(out, summary)
         return out
+
+    def decode_counters(self, summary) -> Dict[str, np.ndarray]:
+        """Slice the named counter bank off a summary vector's tail.
+
+        The bank rides the LAST ``len(self._counter_names)`` lanes of
+        the packed summary, so the decode is a trailing-axis slice and
+        works unchanged on a room-batched ``[R, L]`` summary (the room
+        engine vmaps the step, giving every lane a leading room axis):
+        scalars come back for a single world, per-room ``[R]`` columns
+        for a batch."""
+        names = self._counter_names
+        if not names:
+            return {}
+        arr = np.asarray(summary)
+        tail = arr[..., arr.shape[-1] - len(names):]
+        return {k: tail[..., i] for i, k in enumerate(names)}
 
     def run_device(self, n: int, reconcile: bool = True) -> int:
         """Advance n frames entirely on device (lax.fori_loop over the

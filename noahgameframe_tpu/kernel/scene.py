@@ -33,6 +33,26 @@ MAX_GROUPS_PER_SCENE = 1024  # fixes the cell_key encoding
 SceneHookFn = Callable[[Guid, int, int], None]
 
 
+class GroupIdsExhausted(RuntimeError):
+    """A scene's group-id space (MAX_GROUPS_PER_SCENE) is fully minted
+    AND nothing sits on the free list.
+
+    Typed so long-lived churn drivers (room directories cycling dungeon
+    instances for hours) can catch it and shed load instead of dying on
+    a bare RuntimeError mid-choreography.  Released ids recycle through
+    ``SceneInfo.free_groups`` (release_group appends, request_group pops),
+    so steady-state create/destroy churn never raises this — only >1023
+    groups truly live at once in one scene does."""
+
+    def __init__(self, scene_id: int, limit: int = MAX_GROUPS_PER_SCENE):
+        self.scene_id = int(scene_id)
+        self.limit = int(limit)
+        super().__init__(
+            f"scene {scene_id} group ids exhausted "
+            f"({limit} live groups, none released)"
+        )
+
+
 @dataclasses.dataclass
 class SeedSpec:
     """An NPC seed planted in a scene: spawned into every new group
@@ -115,7 +135,7 @@ class SceneModule(Module):
             gid = info.next_group
             info.next_group += 1
             if gid >= MAX_GROUPS_PER_SCENE:
-                raise RuntimeError(f"scene {scene_id} group ids exhausted")
+                raise GroupIdsExhausted(scene_id)
         group = GroupInfo(gid)
         info.groups[gid] = group
         if seed_npcs:

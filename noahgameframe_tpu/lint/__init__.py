@@ -36,6 +36,7 @@ from .rules_contracts import (
 from .rules_determinism import UnseededRngRule, WallClockRule
 from .rules_mesh import MeshNotCapturedRule
 from .rules_pallas import PallasParityPinnedRule
+from .rules_rooms import RoomAxisCoveredRule
 from .rules_serving import ServeLoopRule
 from .rules_store import MigrateCoversStoreRule
 from .rules_trace import RecompileHazardRule, TraceSafetyRule
@@ -57,6 +58,7 @@ ALL_RULES = (
     MigrateCoversStoreRule,
     MeshNotCapturedRule,
     PallasParityPinnedRule,
+    RoomAxisCoveredRule,
 )
 
 RULES_BY_NAME = {cls.name: cls for cls in ALL_RULES}

@@ -280,6 +280,9 @@ class GameRole(ServerRole):
         self.checkpoint_dir = _Path(checkpoint_dir) if checkpoint_dir else None
         self.checkpoint_seconds = checkpoint_seconds
         self._last_checkpoint = 0.0
+        # many-worlds room directory (parallel/rooms.py), attached via
+        # attach_rooms(); None = this role serves its single GameWorld
+        self.rooms = None
         # flight recorder (replay/journal.py): when a journal dir is
         # given, every dispatched net event + a per-tick on-device state
         # digest is logged so the run can be re-executed offline.  The
@@ -654,6 +657,11 @@ class GameRole(ServerRole):
         ext.key.append(b"costbook")
         ext.value.append(
             _json.dumps(self.kernel.costbook.summary()).encode())
+        # many-worlds occupancy blob: slot totals + per-room placement,
+        # surfaced on the master's /json like pipeline/costbook
+        if self.rooms is not None:
+            ext.key.append(b"rooms")
+            ext.value.append(_json.dumps(self.rooms.status()).encode())
         return r
 
     def pipeline_stats(self) -> dict:
@@ -1960,6 +1968,50 @@ class GameRole(ServerRole):
         if el is None:
             raise RuntimeError(f"{self.config.name}: world is not sharded")
         el.begin_drain(int(device_index))
+
+    # ------------------------------------------------------- many worlds
+    def attach_rooms(self, directory) -> None:
+        """Host a many-worlds RoomDirectory (parallel/rooms.py) beside
+        the single world: room status rides the heartbeat ext and the
+        room churn verbs below become drill-addressable."""
+        self.rooms = directory
+
+    def _rooms_or_raise(self):
+        if self.rooms is None:
+            raise RuntimeError(
+                f"{self.config.name}: no RoomDirectory attached")
+        return self.rooms
+
+    def create_room(self, seed: Optional[int] = None,
+                    room_id: Optional[int] = None,
+                    control: bool = False) -> int:
+        return self._rooms_or_raise().create_room(
+            seed=seed, room_id=room_id, control=control)
+
+    def destroy_room(self, room_id: int) -> int:
+        """Free the room's slot and release every session routed to it
+        (same reset discipline as a completed reshard: the seen-state
+        wipe is lazy, the routing column clears now)."""
+        d = self._rooms_or_raise()
+        slot = d.destroy_room(room_id)
+        table = self._session_table
+        if table is not None:
+            for key in table.sessions_in_room(room_id):
+                table.release(key)
+        return slot
+
+    def rehome_room(self, room_id: int):
+        """Move a room to another slot/device; sessions keep their
+        routing (the room id is stable — only its slot changed), but
+        their views reset so the next serve pass resends from the
+        re-homed state."""
+        d = self._rooms_or_raise()
+        src_dst = d.rehome_room(room_id)
+        table = self._session_table
+        if table is not None:
+            for key in table.sessions_in_room(room_id):
+                table.reset_view(key)
+        return src_dst
 
     def _reset_views_for_moved(self, moved: Dict[str, np.ndarray]) -> None:
         """Force reset_view for sessions whose seen-state references rows

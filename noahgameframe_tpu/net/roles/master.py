@@ -313,6 +313,30 @@ class MasterRole(ServerRole):
         cb = self._costbook_ext()
         if cb:
             status["costbook"] = cb
+        # many-worlds occupancy: each game hosting a RoomDirectory ships
+        # slot totals + per-room placement in its heartbeat ext; surface
+        # them per game plus cluster-wide room totals
+        rooms: Dict[str, dict] = {}
+        for sid, reg in sorted(
+            self.registry.get(int(ServerType.GAME), {}).items()
+        ):
+            blob = self._ext_of(reg.report).get("rooms")
+            if not blob:
+                continue
+            try:
+                rooms[str(sid)] = _json.loads(blob)
+            except ValueError:
+                rooms[str(sid)] = {"error": "unparseable rooms ext"}
+        if rooms:
+            status["rooms"] = {
+                "games": rooms,
+                "total_active": sum(
+                    int(g.get("active", 0)) for g in rooms.values()
+                    if isinstance(g.get("active", 0), int)),
+                "total_slots_free": sum(
+                    int(g.get("slots_free", 0)) for g in rooms.values()
+                    if isinstance(g.get("slots_free", 0), int)),
+            }
         return status
 
     def _costbook_ext(self) -> Dict[str, dict]:

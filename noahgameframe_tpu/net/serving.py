@@ -54,6 +54,11 @@ class SessionTable:
         self.conn_id = np.zeros(0, np.int64)
         self.avatar_row = np.zeros(0, np.int32)
         self.valid = np.zeros(0, bool)
+        # room this session is routed to under the many-worlds engine
+        # (parallel/rooms.py); -1 = the host's single world.  Routing is
+        # a host column only — the serve edge filters lanes per room, it
+        # never crosses the device room axis.
+        self.room = np.full(0, -1, np.int32)
         # per-class device seen-state, lazily sized [capacity, M]
         self.seen: Dict[str, SeenTable] = {}
         self._seen_m: Dict[str, int] = {}
@@ -71,6 +76,7 @@ class SessionTable:
             [self.avatar_row, np.zeros(pad, np.int32)]
         )
         self.valid = np.concatenate([self.valid, np.zeros(pad, bool)])
+        self.room = np.concatenate([self.room, np.full(pad, -1, np.int32)])
         self._key_of.extend([None] * pad)
         self._free.extend(range(new_cap - 1, self.capacity - 1, -1))
         for cname, tbl in list(self.seen.items()):
@@ -95,6 +101,10 @@ class SessionTable:
             if slot in self._stale:
                 self._stale.discard(slot)
                 self._wipe_seen(slot)
+            # a recycled slot must not inherit the previous occupant's
+            # room routing (same lazy-wipe discipline as seen-state,
+            # except the column is host-side so the wipe is free)
+            self.room[slot] = -1
         self.conn_id[slot] = conn_id
         self.avatar_row[slot] = avatar_row
         self.valid[slot] = True
@@ -118,6 +128,24 @@ class SessionTable:
         slot = self.slot_of.get(key)
         if slot is not None:
             self.valid[slot] = False
+
+    # ------------------------------------------------------------- rooms
+    def bind_room(self, key: Hashable, room_id: int) -> None:
+        """Route a session to a room of the many-worlds engine; -1
+        returns it to the host's single world."""
+        self.room[self.slot_of[key]] = int(room_id)
+
+    def room_of(self, key: Hashable) -> int:
+        slot = self.slot_of.get(key)
+        return -1 if slot is None else int(self.room[slot])
+
+    def sessions_in_room(self, room_id: int) -> List[Hashable]:
+        """Keys of every live session routed to `room_id` — the set a
+        room destroy/re-home must release or reset."""
+        rid = int(room_id)
+        return [self._key_of[s] for s in np.flatnonzero(
+            (self.room == rid) & self.valid)
+            if self._key_of[s] is not None]
 
     def reset_view(self, key: Hashable) -> None:
         """Wipe the session's device seen-state NOW (the batched half of

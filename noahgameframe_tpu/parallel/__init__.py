@@ -6,7 +6,19 @@ here to jax.sharding over ICI/DCN.
 """
 
 from .elastic import Autoscaler, AutoscalePolicy, DigestControl, ElasticMesh
-from .mesh import SHARD_AXIS, make_mesh, replicated, row_sharding
+from .mesh import ROOMS_AXIS, SHARD_AXIS, make_mesh, replicated, row_sharding
+from .rooms import (
+    ROOM_EXCLUDED,
+    ROOM_PACK_SPEC,
+    RoomBatch,
+    RoomBinPacker,
+    RoomDirectory,
+    RoomSlotsFull,
+    pack_room_blob,
+    room_digest,
+    unpack_room_blob,
+    world_room_leaf_items,
+)
 from .multihost import (
     DistRendezvous,
     global_mesh,
@@ -21,7 +33,12 @@ from .rowmigrate import (
     mesh_migrate_class,
     migrate_rows,
 )
-from .shard import ShardedKernel, shard_rows_by_cell, world_shardings
+from .shard import (
+    ShardedKernel,
+    room_shardings,
+    shard_rows_by_cell,
+    world_shardings,
+)
 from .spatial import SpatialGeom, SpatialState, SpatialWorld
 
 __all__ = [
@@ -37,8 +54,20 @@ __all__ = [
     "init_distributed",
     "mesh_migrate_class",
     "migrate_rows",
+    "pack_room_blob",
     "rendezvous_via_master",
+    "room_digest",
+    "room_shardings",
     "serve_dist",
+    "unpack_room_blob",
+    "world_room_leaf_items",
+    "ROOM_EXCLUDED",
+    "ROOM_PACK_SPEC",
+    "ROOMS_AXIS",
+    "RoomBatch",
+    "RoomBinPacker",
+    "RoomDirectory",
+    "RoomSlotsFull",
     "SHARD_AXIS",
     "ShardedKernel",
     "SpatialGeom",

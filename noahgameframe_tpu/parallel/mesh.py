@@ -21,6 +21,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 SHARD_AXIS = "shard"
+# the many-worlds room engine (parallel/rooms.py) batches INDEPENDENT
+# rooms on a leading [R] axis and shards that axis instead of the
+# entity axis — one mesh, two orthogonal scale shapes
+ROOMS_AXIS = "rooms"
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = SHARD_AXIS) -> Mesh:

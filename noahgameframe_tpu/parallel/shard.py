@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..core.store import WorldState
 from ..kernel.kernel import Kernel
-from .mesh import SHARD_AXIS, make_mesh
+from .mesh import ROOMS_AXIS, SHARD_AXIS, make_mesh
 
 
 def world_shardings(state: WorldState, mesh: Mesh, axis: str = SHARD_AXIS):
@@ -47,6 +47,28 @@ def world_shardings(state: WorldState, mesh: Mesh, axis: str = SHARD_AXIS):
     # axes shard like class banks, counters/anchors-of-scalars replicate
     aux = jax.tree.map(pick, state.aux)
     return state.replace(classes=classes, tick=rep, rng=rep, aux=aux)
+
+
+def room_shardings(state, mesh: Mesh, axis: str = ROOMS_AXIS):
+    """Pytree of NamedShardings for a ROOM-BATCHED WorldState: every
+    leaf carries a leading ``[R]`` room axis (tick and rng included —
+    rooms tick independently), so the whole tree shards room-major.
+    Contrast :func:`world_shardings`, which shards the entity axis and
+    replicates scalars; here there are no scalars left to replicate."""
+    row = NamedSharding(mesh, PartitionSpec(axis))
+    n_dev = mesh.devices.size
+
+    def pick(leaf):
+        if not (hasattr(leaf, "ndim") and leaf.ndim >= 1
+                and leaf.shape[0] > 0 and leaf.shape[0] % n_dev == 0):
+            raise ValueError(
+                f"room-batched leaf shape {getattr(leaf, 'shape', None)} "
+                f"has no [R] axis divisible by {n_dev} devices — "
+                "RoomBatch pads capacity to pow2; is this state batched?"
+            )
+        return row
+
+    return jax.tree.map(pick, state)
 
 
 class ShardedKernel:

@@ -203,6 +203,20 @@ while :; do
     echo "[$(date -u +%H:%M:%S)] SKIP reshard4 — backend exposes <4 devices"
   fi
 
+  # 11. many-worlds rooms ladder on chip (ISSUE 19 r12): thousands of
+  #     independent rooms vmapped as one batch, room-major sharded.
+  #     Guarded like reshard: the mesh width adapts to what the tunnel
+  #     actually exposes (1-chip slices are fine — the rooms axis still
+  #     batches, it just doesn't shard).
+  NDEV=$(timeout 110 python -c "import jax; print(len(jax.devices()))" 2>/dev/null || echo 0)
+  if [ "$NDEV" -ge 1 ]; then
+    run_item rooms 1800 python -u bench.py --rooms "$NDEV" --platform tpu \
+        --rooms-count 64,256,1024 --rooms-entities 64 \
+      && save_json rooms bench_runs/r12_rooms_tpu.json
+  else
+    echo "[$(date -u +%H:%M:%S)] SKIP rooms — no devices exposed"
+  fi
+
   n_done=$(ls "$STAMPS" | wc -l)
   if [ "$n_done" -ge 25 ]; then
     echo "[$(date -u +%H:%M:%S)] queue drained — exiting"

@@ -25,3 +25,11 @@ class ClassState:
     shadow: "Array"  # <- new bank, not in the spec
     timers: "TimerState"
     records: "Dict[str, RecordState]"
+
+
+class WorldState:
+    classes: "Dict[str, ClassState]"
+    tick: "Array"
+    rng: "Array"
+    aux: "Dict[str, Any]"
+    era: "Array"  # <- new world leaf, not in the room pack spec

@@ -23,3 +23,10 @@ class ClassState:
     alive: "Array"
     timers: "TimerState"
     records: "Dict[str, RecordState]"
+
+
+class WorldState:
+    classes: "Dict[str, ClassState]"
+    tick: "Array"
+    rng: "Array"
+    aux: "Dict[str, Any]"

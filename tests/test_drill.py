@@ -37,6 +37,7 @@ from noahgameframe_tpu.drill import (
     MonotoneWatermarks,
     NoSilentDrop,
     OrderedReplay,
+    RoomIsolation,
     Step,
     default_invariants,
     merged,
@@ -685,3 +686,109 @@ class TestReshardE2E:
         blob = json.loads((tmp_path / "r10_reshard.json").read_text())
         assert blob["metric"] == "reshard_gameday_exodus_ticks"
         assert blob["detail"]["drill_clean"] is True
+
+
+# --------------------------------- many-worlds room actions + isolation
+def _room_cluster(log):
+    """Forged cluster whose game role records room-action dispatch."""
+    role = SimpleNamespace(
+        config=SimpleNamespace(name="Game1"),
+        create_room=lambda seed, room_id, control: log.append(
+            ("create_room", seed, room_id, control)),
+        destroy_room=lambda rid: log.append(("destroy_room", rid)),
+        rehome_room=lambda rid: log.append(("rehome_room", rid)),
+    )
+    return SimpleNamespace(
+        execute=lambda: log.append(("pump",)),
+        chaos=None,
+        roles=[role],
+    )
+
+
+class TestRoomActions:
+    def test_room_actions_dispatch_with_kwargs(self):
+        log = []
+        c = (Campaign("t")
+             .add(0, "create_room", role="Game1", seed=7, room_id=3,
+                  control=True)
+             .add(1, "rehome_room", role="Game1", room_id=3)
+             .add(2, "destroy_room", role="Game1", room_id=3))
+        r = DrillRunner(_room_cluster(log), c, invariants=[],
+                        registry=MetricsRegistry())
+        for _ in range(3):
+            r.step_once()
+        assert log == [
+            ("create_room", 7, 3, True), ("pump",),
+            ("rehome_room", 3), ("pump",),
+            ("destroy_room", 3), ("pump",),
+        ]
+
+    def test_room_actions_are_builtin(self):
+        for action in ("create_room", "destroy_room", "rehome_room"):
+            Campaign("t").add(0, action, role="Game1", room_id=1)
+
+
+def _room_game(digests, controls=(1,), rooms=None, tick=5, calls=None):
+    """Forged game role hosting a rooms-directory stand-in.
+
+    ``digests`` maps room_id -> (live, want)."""
+    calls = calls if calls is not None else []
+
+    def digest(rid):
+        calls.append(("digest", rid))
+        return digests[rid][0]
+
+    directory = SimpleNamespace(
+        controls={rid: object() for rid in controls},
+        rooms=dict(rooms if rooms is not None
+                   else {rid: rid for rid in controls}),
+        batch=SimpleNamespace(tick_count=tick),
+        digest=digest,
+        control_digest=lambda rid: digests[rid][1],
+    )
+    return SimpleNamespace(config=SimpleNamespace(name="Game1"),
+                           rooms=directory)
+
+
+class TestRoomIsolation:
+    def test_divergent_room_violates(self):
+        game = _room_game({1: (0xAA, 0xAA), 2: (0xDEAD, 0xBEEF)},
+                          controls=(1, 2))
+        inv = RoomIsolation()
+        out = inv.check(_ctx(SimpleNamespace(games=[game])))
+        assert len(out) == 1 and "room 2" in out[0]
+        assert "cross-room leak" in out[0]
+
+    def test_lockstep_rooms_are_clean_and_roomless_games_skipped(self):
+        game = _room_game({1: (0x5150, 0x5150)})
+        bare = SimpleNamespace(config=SimpleNamespace(name="Game2"))
+        inv = RoomIsolation()
+        assert inv.check(_ctx(SimpleNamespace(games=[game, bare]))) == []
+
+    def test_static_batch_is_not_redigested(self):
+        calls = []
+        game = _room_game({1: (7, 7)}, calls=calls)
+        inv = RoomIsolation()
+        inv.check(_ctx(SimpleNamespace(games=[game]), tick=0))
+        inv.check(_ctx(SimpleNamespace(games=[game]), tick=1))
+        assert calls == [("digest", 1)]  # tick_count never moved
+        game.rooms.batch.tick_count = 6
+        inv.check(_ctx(SimpleNamespace(games=[game]), tick=2))
+        assert calls == [("digest", 1), ("digest", 1)]
+
+    def test_sample_every_gates_drill_ticks(self):
+        calls = []
+        game = _room_game({1: (7, 7)}, calls=calls)
+        inv = RoomIsolation(sample_every=4)
+        for t in range(4):
+            game.rooms.batch.tick_count = 5 + t
+            inv.check(_ctx(SimpleNamespace(games=[game]), tick=t))
+        assert calls == [("digest", 1)]  # only drill tick 0 sampled
+
+    def test_destroyed_room_with_straggler_control_skipped(self):
+        calls = []
+        game = _room_game({1: (1, 2)}, controls=(1,), rooms={},
+                          calls=calls)
+        inv = RoomIsolation()
+        assert inv.check(_ctx(SimpleNamespace(games=[game]))) == []
+        assert calls == []

@@ -175,3 +175,25 @@ def test_scene_process_normal_vs_clone():
     # re-entering a clone scene swaps the old instance for a fresh one
     cb2 = sp.enter(b, 7)
     assert cb2 != cb and cb not in scene.scenes[7].groups
+
+
+def test_group_id_exhaustion_is_typed_and_recycling_recovers():
+    """Minting past MAX_GROUPS_PER_SCENE raises the typed error (with
+    the scene and the limit on it), and releasing any group makes the
+    id space whole again — recycled ids are handed out before fresh
+    ones, so a churning scene never exhausts."""
+    from noahgameframe_tpu.kernel.scene import GroupIdsExhausted
+
+    pm, kernel, scene = setup_world()
+    gids = [scene.request_group(1, seed_npcs=False)
+            for _ in range(MAX_GROUPS_PER_SCENE - 1)]
+    with pytest.raises(GroupIdsExhausted) as ei:
+        scene.request_group(1, seed_npcs=False)
+    assert ei.value.scene_id == 1
+    assert ei.value.limit == MAX_GROUPS_PER_SCENE
+    assert "exhausted" in str(ei.value)
+    # other scenes have their own id space
+    assert scene.request_group(2, seed_npcs=False) == 1
+    # release -> the freed id is recycled, not a fresh mint
+    scene.release_group(1, gids[41])
+    assert scene.request_group(1, seed_npcs=False) == gids[41]
