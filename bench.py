@@ -1024,7 +1024,24 @@ def run_rooms(args) -> dict:
     per_room = int(args.rooms_entities)
     seeded = max(1, per_room // 2)
     ticks = int(args.rooms_ticks)
+    train_k = int(getattr(args, "train", 0) or 0)
     mesh = make_mesh(args.rooms, axis=ROOMS_AXIS)
+
+    def r12_point(n_rooms):
+        """The committed r12 (K=1) rung matching this one, for honest
+        speedup ratios in the train arm; None when no artifact."""
+        name = ("r12_rooms_tpu.json" if args.platform == "tpu"
+                else "r12_rooms_cpu.json")
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_runs", name)
+        try:
+            with open(path) as f:
+                for p in json.load(f)["detail"]["points"]:
+                    if p.get("rooms") == n_rooms:
+                        return p
+        except Exception:  # noqa: BLE001
+            return None
+        return None
 
     def point(n_rooms):
         if n_rooms % args.rooms:
@@ -1048,12 +1065,16 @@ def run_rooms(args) -> dict:
             return w.kernel.state.replace(
                 rng=jax.random.PRNGKey(args.seed + i))
 
-        # warm-up compiles every entry once (admit/step/run/extract),
-        # then the no-recompile gate arms: churn after the mark must be
-        # free (slot indices are traced scalars)
+        # warm-up compiles every entry once (admit/step/run/extract,
+        # plus the K-tick train when elected), then the no-recompile
+        # gate arms: churn after the mark must be free (slot indices
+        # are traced scalars)
         batch.admit(packer.alloc(), room_of(0))
         batch.tick()
         batch.run(1)
+        if train_k > 1:
+            batch.configure_train(train_k)
+            batch.train(train_k)
         batch.extract(0)
         batch.rehome(0, 1)
         packer.free(0)
@@ -1088,6 +1109,48 @@ def run_rooms(args) -> dict:
         run_s = time.perf_counter() - t0
         room_ticks = n_rooms * 2 * ticks / run_s
 
+        # K-tick train throughput (ISSUE 20): same 2*ticks span as the
+        # fused window, but every tick's [R, L] counter lane comes back
+        # to the host — the OBSERVED path at ceil(n/K) dispatches.  The
+        # dispatch gate pins the count exactly; a retrace or a silent
+        # per-tick fallback would break it.
+        train = {}
+        if train_k > 1:
+            n_train = 2 * ticks
+            d0 = batch.train_dispatches
+            t0 = time.perf_counter()
+            lanes = batch.train(n_train)
+            train_s = time.perf_counter() - t0
+            t_dispatches = batch.train_dispatches - d0
+            want = n_train // train_k  # tail singles ride _jit_step
+            train = {
+                "tick_train": train_k,
+                "train_ticks_timed": n_train,
+                "train_tick_ms": round(train_s * 1e3 / n_train, 3),
+                "train_room_ticks_per_sec": round(
+                    n_rooms * n_train / train_s, 1),
+                "train_dispatches": t_dispatches,
+                "train_dispatch_gate": t_dispatches == want,
+                "train_rows": int(lanes.shape[0]),
+                "train_fetch_bytes": batch.train_fetch_bytes,
+            }
+            # honest ratios against the committed K=1 round: both the
+            # observed path it replaces (r12 tick_p50, per-tick fetch)
+            # and the fused path it cannot beat on fetch volume
+            base = r12_point(n_rooms)
+            if base:
+                b_ms = float(base["tick_p50_ms"])
+                b_obs = n_rooms / b_ms * 1e3
+                train["baseline_r12_k1_tick_ms"] = b_ms
+                train["baseline_r12_k1_room_ticks_per_sec"] = round(
+                    b_obs, 1)
+                train["speedup_vs_r12_k1_observed"] = round(
+                    train["train_room_ticks_per_sec"] / b_obs, 2)
+                b_fused = float(base["room_ticks_per_sec"])
+                train["baseline_r12_fused_room_ticks_per_sec"] = b_fused
+                train["speedup_vs_r12_fused"] = round(
+                    train["train_room_ticks_per_sec"] / b_fused, 2)
+
         # churn: rotate rooms through the spare slot, nothing may drop
         def rows():
             return int(np.asarray(
@@ -1103,6 +1166,40 @@ def run_rooms(args) -> dict:
             used.append(dst)
         dropped = before - rows()
         unexplained = batch.costbook.unexplained_since(mark)
+
+        # digest parity (ISSUE 20 acceptance): fresh train batch vs a
+        # fresh single-ticking control, 120 ticks — every tick's
+        # state_digest lane bit-identical across all R rooms, ragged
+        # tail included.  Runs after the gates: enable_digest() is a
+        # sanctioned retrace and must not pollute the churn CostBook.
+        parity = {}
+        if train_k > 1:
+            w.kernel.enable_digest()
+
+            def parity_batch():
+                pb = RoomBatch(w.kernel, n_rooms, mesh=mesh)
+                pk = RoomBinPacker(pb.capacity,
+                                   n_blocks=mesh.devices.size)
+                for i in range(n_rooms):
+                    pb.admit(pk.alloc(), room_of(i))
+                return pb
+
+            pb_t, pb_c = parity_batch(), parity_batch()
+            pb_t.configure_train(train_k)
+            p_ticks = 120
+            lanes_p = pb_t.train(p_ticks)
+            ok = True
+            for i in range(p_ticks):
+                c = pb_t.kernel.decode_counters(lanes_p[i])
+                ctl = pb_c.tick()
+                if not (np.array_equal(c["state_digest"],
+                                       ctl["state_digest"])
+                        and np.array_equal(c["tick"], ctl["tick"])):
+                    ok = False
+                    break
+            parity = {"digest_parity_ticks": p_ticks,
+                      "digest_parity": ok}
+
         return {
             "rooms": n_rooms,
             "rooms_admitted": len(used),
@@ -1119,23 +1216,30 @@ def run_rooms(args) -> dict:
             "rehomed": int(args.rooms_churn),
             "dropped_rows": int(dropped),
             "unexplained_recompiles": len(unexplained),
+            **train,
+            **parity,
             "costbook": _costbook_detail(batch.costbook),
         }
 
     points = [point(n) for n in counts]
     head = points[-1]
     return {
-        "metric": "rooms_room_ticks_per_sec",
-        "value": head["room_ticks_per_sec"],
+        "metric": ("rooms_train_room_ticks_per_sec" if train_k > 1
+                   else "rooms_room_ticks_per_sec"),
+        "value": (head["train_room_ticks_per_sec"] if train_k > 1
+                  else head["room_ticks_per_sec"]),
         "unit": "room-ticks/s",
         "detail": {
             "devices": args.rooms,
             "seed": args.seed,
             "platform": jax.devices()[0].platform,
             "ticks_timed": int(args.rooms_ticks),
+            "tick_train": train_k,
             "all_gates": all(
                 p["dropped_rows"] == 0
-                and p["unexplained_recompiles"] == 0 for p in points),
+                and p["unexplained_recompiles"] == 0
+                and p.get("train_dispatch_gate", True)
+                and p.get("digest_parity", True) for p in points),
             "points": points,
         },
     }
@@ -1155,18 +1259,49 @@ def run_bench(args) -> dict:
                                   seed=args.seed)
     k = world.kernel
 
-    # compile + warm up (the trip count is a traced scalar: this ONE
-    # compile serves the timed loop, the single-step pass, and every
-    # latency window below)
-    t_c0 = time.perf_counter()
-    k.run_device(args.ticks)
-    jax.block_until_ready(k.state.classes["NPC"].i32)
-    compile_s = time.perf_counter() - t_c0
+    train_k = int(getattr(args, "train", 0) or 0)
+    if train_k > 1:
+        # K-tick train arm (ISSUE 20): the OBSERVED tick path — every
+        # per-tick lane (digests, diffs, deaths, events) fans out on the
+        # host — in ceil(ticks/K) dispatches instead of one per tick.
+        # tick_ms below is amortized PER TICK, so decide_tuning compares
+        # it against the fused baseline directly: NF_TICK_TRAIN only
+        # promotes when full observability beats the blind fused loop.
+        t_c0 = time.perf_counter()
+        k.configure_train(train_k)
+        k.train(train_k)
+        jax.block_until_ready(k.state.classes["NPC"].i32)
+        compile_s = time.perf_counter() - t_c0
 
-    t0 = time.perf_counter()
-    k.run_device(args.ticks)
-    jax.block_until_ready(k.state.classes["NPC"].i32)
-    dt = time.perf_counter() - t0
+        d0 = k.train_dispatches
+        t0 = time.perf_counter()
+        k.train(args.ticks)
+        jax.block_until_ready(k.state.classes["NPC"].i32)
+        dt = time.perf_counter() - t0
+        train_detail = {
+            "tick_train": train_k,
+            "train_dispatches": k.train_dispatches - d0,
+            "train_ticks_timed": args.ticks,
+            "train_fetch_bytes": k.train_fetch_bytes,
+        }
+        # the latency passes below ride run_device; warm its compile
+        # outside their timed windows
+        k.run_device(1, reconcile=False)
+        jax.block_until_ready(k.state.classes["NPC"].i32)
+    else:
+        train_detail = {}
+        # compile + warm up (the trip count is a traced scalar: this ONE
+        # compile serves the timed loop, the single-step pass, and every
+        # latency window below)
+        t_c0 = time.perf_counter()
+        k.run_device(args.ticks)
+        jax.block_until_ready(k.state.classes["NPC"].i32)
+        compile_s = time.perf_counter() - t_c0
+
+        t0 = time.perf_counter()
+        k.run_device(args.ticks)
+        jax.block_until_ready(k.state.classes["NPC"].i32)
+        dt = time.perf_counter() - t0
 
     # per-tick latency distribution on the single-step path (the latency a
     # 30 Hz world-tick loop would see; run_device amortises dispatch, the
@@ -1275,6 +1410,7 @@ def run_bench(args) -> dict:
             "device": str(dev),
             "platform": dev.platform,
             "combat": not args.no_combat,
+            **train_detail,
             "grid_overflow_max": grid_drop,
             "att_overflow_max": att_drop,
             # on-device counter bank from the reconciling tick above
@@ -1421,17 +1557,21 @@ def _run_pallas_ab(args) -> dict:
     so respawning is the only way to get three honest traces — and a
     crash or OOM in one engine can't burn the others' points.  Each
     point keeps its ``combat.fold_p*`` costbook entry, so the r11
-    artifact reads split-vs-fused bytes_accessed from one payload."""
-    def one(engine: int) -> dict:
+    artifact reads split-vs-fused bytes_accessed from one payload.
+    With ``--train K`` a fourth arm rides along: the winning fused
+    engine re-run under K-tick observed trains (r13)."""
+    def one(engine: int, train: int = 0) -> dict:
         cmd = [
             sys.executable, "-u", __file__,
             "--entities", str(args.entities), "--ticks", str(args.ticks),
             "--seed", str(args.seed), "--platform", args.platform,
             "--pallas", str(engine),
         ]
+        if train > 1:
+            cmd += ["--train", str(train)]
         if args.no_combat:
             cmd.append("--no-combat")
-        point = {"pallas": engine}
+        point = {"pallas": engine, "tick_train": train}
         try:
             r = subprocess.run(
                 cmd, capture_output=True, text=True,
@@ -1451,7 +1591,8 @@ def _run_pallas_ab(args) -> dict:
                 point["value"] = p.get("value")
                 d = p.get("detail") or {}
                 for key in ("tick_ms", "tick_ms_p50_device", "platform",
-                            "pallas_engine", "pallas_probe", "binning"):
+                            "pallas_engine", "pallas_probe", "binning",
+                            "tick_train", "train_dispatches"):
                     point[key] = d.get(key)
                 entries = ((d.get("costbook") or {}).get("entries")) or {}
                 point["fold_entries"] = {
@@ -1464,6 +1605,9 @@ def _run_pallas_ab(args) -> dict:
         return point
 
     points = [one(e) for e in (0, 1, 2)]
+    train_k = int(getattr(args, "train", 0) or 0)
+    if train_k > 1:
+        points.append(one(2, train=train_k))
     head = next(
         (p for p in points if p.get("value") and not p.get("error")), None
     )
@@ -1692,6 +1836,15 @@ def main() -> None:
         "--rooms-ticks", type=int, default=30,
         help="individually-timed batch ticks per rung (the fused "
              "throughput window runs 2x this)",
+    )
+    ap.add_argument(
+        "--train", type=int, default=0, metavar="K",
+        help="K-tick observed trains (NF_TICK_TRAIN): one lax.scan "
+             "dispatch covers K ticks with every per-tick lane stacked "
+             "[K,...] for the host.  Device-loop mode times k.train() "
+             "instead of run_device(); the rooms ladder adds a train "
+             "throughput arm + a 120-tick per-tick digest-parity gate "
+             "against a K=1 control (r13 evidence).  0/1 = off",
     )
     ap.add_argument(
         "--mig-entities", default=None, metavar="N,N,...",

@@ -521,8 +521,19 @@ class EntityStore:
         host = self._hosts[class_name]
         alive = np.asarray(state.classes[class_name].alive)
         dead_rows = np.flatnonzero(host.alloc_mask & ~alive)
+        return self.release_rows(class_name, dead_rows)
+
+    def release_rows(self, class_name: str, rows) -> List[Guid]:
+        """Free exactly `rows` (device-killed) and return their guids.
+        The tick-train fan-out uses this with each stacked frame's own
+        died mask: the post-train alive scan of reconcile_deaths cannot
+        say WHICH tick killed a row, but the per-lane mask can.  Rows
+        already free are skipped, so replaying a lane is harmless."""
+        host = self._hosts[class_name]
         dead: List[Guid] = []
-        for row in dead_rows.tolist():
+        for row in np.asarray(rows).tolist():
+            if not host.alloc_mask[row]:
+                continue
             g = host.row_guid[row]
             if g is None:
                 continue

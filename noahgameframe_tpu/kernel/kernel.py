@@ -22,6 +22,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import enum
+from fnmatch import fnmatch
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -61,6 +62,48 @@ REC_NONE, REC_ADDED, REC_REMOVED, REC_UPDATED = 0, 1, 2, 3
 # multiplier for the rolling state-digest fold (odd, so it is invertible
 # mod 2^32 and single-bit flips diffuse instead of cancelling)
 _DIGEST_MULT = 1000003
+
+# Every per-tick output lane of _trace_step that host code consumes must
+# match one of these patterns (or appear in TRAIN_EXCLUDED with a
+# reason): the K-tick train stacks exactly these lanes into [K, ...]
+# device arrays, and a lane missing from the stack would silently lose
+# its per-tick history inside a train (journal digests, death masks,
+# event params all ride here).  The train-lanes-covered nf-lint rule
+# cross-checks this tuple against every `out[...]` consumer statically;
+# _assert_train_lanes enforces it at trace time.  Keep it a plain
+# literal (the ROW_LEAF_SPEC / ROOM_PACK_SPEC contract).
+TRAIN_LANE_SPEC = (
+    "fired",
+    "diff",
+    "diff_count",
+    "rec_diff",
+    "rec_diff_count",
+    "died",
+    "died_count",
+    "events",
+    "summary",
+)
+
+# Out-dict lanes waived from train stacking, with a reason each.
+# (none today — every per-tick output is host-consumed)
+TRAIN_EXCLUDED = ()
+
+
+def _assert_train_lanes(out: Dict[str, object]) -> None:
+    """Trace-time coverage assert for the train's stacked lane set.
+
+    Both directions, like world_room_leaf_items: an out lane not named
+    by TRAIN_LANE_SPEC/TRAIN_EXCLUDED means a new per-tick output was
+    added without deciding its train fate; a spec pattern matching no
+    lane is stale and must be pruned."""
+    spec = TRAIN_LANE_SPEC + TRAIN_EXCLUDED
+    unlisted = [k for k in out if not any(fnmatch(k, p) for p in spec)]
+    stale = [p for p in spec if not any(fnmatch(k, p) for k in out)]
+    if unlisted or stale:
+        raise AssertionError(
+            "TRAIN_LANE_SPEC drift: "
+            f"unlisted out lanes {unlisted}, stale patterns {stale}"
+        )
 
 
 def _digest_u32(x: jnp.ndarray) -> jnp.ndarray:
@@ -243,6 +286,18 @@ class Kernel(Module):
         self._composed: List[Phase] = []
         self._jit_step = None
         self._jit_run = None
+        # K-tick train (NF_TICK_TRAIN): one lax.scan dispatch covering
+        # _train_k frames with every host-consumed lane stacked [K, ...]
+        # (TRAIN_LANE_SPEC).  K is a compile-time constant of the train
+        # executable (lax.scan lengths are static by construction);
+        # ragged tails ride kernel.step, so one train compile + the
+        # always-present step compile serve every run length.
+        self._jit_train = None
+        self._train_k = 0
+        # train accounting, surfaced as nf_train_*_total by telemetry
+        self.train_dispatches = 0
+        self.train_ticks = 0
+        self.train_fetch_bytes = 0
         # monotonically bumped whenever the compiled tick is dropped
         # (invalidate / set_phases) so WRAPPING compilers — ShardedKernel
         # keeps its own jitted variants of _trace_step — can notice and
@@ -323,6 +378,7 @@ class Kernel(Module):
         self._composed = sorted(phases, key=lambda p: p.order)
         self._jit_step = None
         self._jit_run = None
+        self._jit_train = None
         self._trace_gen += 1
         self.costbook.generation_bump("set_phases")
 
@@ -442,6 +498,11 @@ class Kernel(Module):
         counters["diff_cells"] = sum(diff_count.values(), zero)
         counters["rec_diff_cells"] = sum(rec_diff_count.values(), zero)
         counters["events_fired"] = sum(ev_counts, zero)
+        # the tick's own logical number (post-increment, i.e. the value
+        # tick_count reaches once this frame lands) rides in-lane so a
+        # K-tick train can stamp journal marks and death attribution
+        # with the REAL tick of each stacked frame, not the train's end
+        counters["tick"] = state.tick
         if self.digest_enabled:
             # post-increment state, i.e. exactly what a checkpoint taken
             # after this tick would capture — replay compares like for like
@@ -501,6 +562,7 @@ class Kernel(Module):
         and the first new tick rebuilds them."""
         self._jit_step = None
         self._jit_run = None
+        self._jit_train = None
         self._trace_gen += 1
         # sanctioned retrace: anything compiled after this bump is an
         # expected recompile, not a hazard (soak-gate allowlist seam)
@@ -603,8 +665,8 @@ class Kernel(Module):
             }
             self.last_counters = dict(out.counters)
             for k, v in out.counters.items():
-                if k == "state_digest":
-                    continue  # a hash; summing it is noise, not a counter
+                if k in ("state_digest", "tick"):
+                    continue  # a hash / a stamp; summing either is noise
                 self.counter_totals[k] = self.counter_totals.get(k, 0) + v
         with self._span("kernel.post_tick"):
             self._post_tick(out, summary)
@@ -667,7 +729,128 @@ class Kernel(Module):
                 freed += 1
         return freed
 
-    def _post_tick(self, out: TickOutputs, summary: np.ndarray) -> None:
+    # -- K-tick trains (NF_TICK_TRAIN) --------------------------------------
+
+    def configure_train(self, k: int) -> None:
+        """Pin the train length.  Changing K drops only the train
+        executable (the step/run traces are K-independent); the retrace
+        is announced like every other sanctioned recompile so soak
+        gates armed across a reconfigure stay clean."""
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"train length must be >= 1, got {k}")
+        if k == self._train_k:
+            return
+        self._train_k = k
+        if self._jit_train is not None:
+            self._jit_train = None
+            self.costbook.generation_bump(f"train_k:{k}")
+
+    def _trace_train(self, state: WorldState):
+        """K steps under ONE lax.scan whose per-tick outputs scan-stack
+        into [K, ...] lanes — the whole observed surface of K frames in
+        one dispatch + one summary fetch.  Plain scan, not unrolled:
+        measured on the rooms flagship shape the rolled loop both runs
+        faster and compiles ~7x faster than an unrolled body."""
+
+        def body(st, _):
+            st2, out = self._trace_step(st)
+            return st2, out
+
+        state, lanes = jax.lax.scan(body, state, None, length=self._train_k)
+        _assert_train_lanes(lanes)
+        return state, lanes
+
+    def compile_train(self) -> None:
+        if self._jit_train is None:
+            if self._train_k < 1:
+                raise RuntimeError("configure_train(k) before train()")
+            self._jit_train = self.costbook.wrap(
+                "kernel.train", self._trace_train,
+                donate_argnums=0, stage="tick",
+            )
+
+    def train_begin(self) -> Dict[str, object]:
+        """Dispatch one K-tick train; same donation hazard and async
+        contract as tick_begin, K frames deep."""
+        self.compile_train()
+        self._ensure_aux()
+        with self._span("kernel.dispatch"):
+            self.state, raw = self._jit_train(self.state)
+            if self.stage_timing:
+                jax.block_until_ready((self.state, raw))
+        self.tick_count += self._train_k
+        self.train_dispatches += 1
+        self.train_ticks += self._train_k
+        return raw
+
+    def train_finish(self, raw: Dict[str, object]) -> List[TickOutputs]:
+        """Fetch one train's stacked lanes and fan out K frames of
+        host-visible effects IN TICK ORDER: lane i's events fire before
+        lane i's deaths free rows, before anything from lane i+1 — the
+        same per-frame sequencing tick_finish gives a single frame.
+        Deaths are attributed from each lane's own died mask (the final
+        carried state cannot say WHICH tick killed a row)."""
+        k = self._train_k
+        with self._span("kernel.summary_fetch"):
+            summary = np.asarray(raw["summary"])  # [K, L]
+        self.train_fetch_bytes += summary.nbytes
+        stacked = {kk: vv for kk, vv in raw.items() if kk != "summary"}
+        outs: List[TickOutputs] = []
+        for i in range(k):
+            lane = jax.tree.map(lambda x: x[i], stacked)
+            out = TickOutputs(
+                fired=lane["fired"],
+                diff=lane["diff"],
+                diff_count=lane["diff_count"],
+                rec_diff=lane["rec_diff"],
+                rec_diff_count=lane["rec_diff_count"],
+                died=lane["died"],
+                died_count=lane["died_count"],
+                events=[
+                    DeviceEvent(eid, cname, mask, dict(params))
+                    for (eid, cname, pnames), (mask, params) in zip(
+                        self._event_meta, lane["events"]
+                    )
+                ],
+            )
+            row = summary[i]
+            if self._counter_names:
+                out.counters = {
+                    kk: int(v)
+                    for kk, v in self.decode_counters(row).items()
+                }
+                self.last_counters = dict(out.counters)
+                for kk, v in out.counters.items():
+                    if kk in ("state_digest", "tick"):
+                        continue
+                    self.counter_totals[kk] = (
+                        self.counter_totals.get(kk, 0) + v
+                    )
+            with self._span("kernel.post_tick"):
+                self._post_tick(out, row, exact_deaths=True)
+            outs.append(out)
+        return outs
+
+    def train(self, n: int) -> List[TickOutputs]:
+        """Advance n frames in ⌊n/K⌋ train dispatches plus a per-tick
+        ragged tail, delivering every frame's host effects — the
+        observed-mode counterpart of run_device.  Returns one
+        TickOutputs per frame, in order; out.counters["tick"] carries
+        each frame's logical number."""
+        n = int(n)
+        k = self._train_k
+        if k < 1:
+            raise RuntimeError("configure_train(k) before train()")
+        outs: List[TickOutputs] = []
+        for _ in range(n // k):
+            outs.extend(self.train_finish(self.train_begin()))
+        for _ in range(n % k):
+            outs.append(self.tick())
+        return outs
+
+    def _post_tick(self, out: TickOutputs, summary: np.ndarray,
+                   exact_deaths: bool = False) -> None:
         n_cls = len(self.store.class_order)
         died_counts = summary[:n_cls]
         diff_keys = sorted(out.diff_count)
@@ -687,11 +870,19 @@ class Kernel(Module):
         ]
         if live_events:
             self.events.dispatch_device_events(live_events, self.store)
-        # deaths: reconcile host allocation + fire destroy events
+        # deaths: reconcile host allocation + fire destroy events.
+        # exact_deaths (the train path) frees the rows named by THIS
+        # frame's died mask — the carried post-train state's alive mask
+        # would pin every death to the train's last tick, so attribution
+        # must come from the lane, not from reconcile's final-state scan
         for cname, cnt in zip(self.store.class_order, died_counts):
             if int(cnt) == 0:
                 continue
-            dead = self.store.reconcile_deaths(self.state, cname)
+            if exact_deaths:
+                rows = np.flatnonzero(np.asarray(out.died[cname]))
+                dead = self.store.release_rows(cname, rows)
+            else:
+                dead = self.store.reconcile_deaths(self.state, cname)
             for g in dead:
                 self._fire_class_event(g, cname, ObjectEvent.DESTROY)
         # property-change host subscribers (batch granularity)

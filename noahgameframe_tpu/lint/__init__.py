@@ -40,6 +40,7 @@ from .rules_rooms import RoomAxisCoveredRule
 from .rules_serving import ServeLoopRule
 from .rules_store import MigrateCoversStoreRule
 from .rules_trace import RecompileHazardRule, TraceSafetyRule
+from .rules_train import TrainLanesCoveredRule
 from .rules_wire import DispatchHandlerRule, StructCodecRule
 
 #: every shipped rule, in catalog order (docs/LINT.md mirrors this)
@@ -59,6 +60,7 @@ ALL_RULES = (
     MeshNotCapturedRule,
     PallasParityPinnedRule,
     RoomAxisCoveredRule,
+    TrainLanesCoveredRule,
 )
 
 RULES_BY_NAME = {cls.name: cls for cls in ALL_RULES}

@@ -1,0 +1,121 @@
+"""Tick-train lane coverage contract (ISSUE 20).
+
+``NF_TICK_TRAIN=K`` compiles a ``lax.scan`` over K kernel ticks into
+ONE dispatch; every per-tick output lane of ``_trace_step`` that host
+code consumes (journal digests, death masks, diff counts, event
+params) is stacked ``[K, ...]`` so the train loses no per-tick
+history.  Like the room-pack and migration walks, the stacking is
+generic — ``lax.scan`` stacks whatever the step returns — so the
+reviewed INTENT lives in one literal: ``TRAIN_LANE_SPEC`` in
+``kernel/kernel.py`` enumerates the lanes a train must carry, and
+``TRAIN_EXCLUDED`` waivers lanes deliberately dropped (each with a
+reason).  This rule is the static complement of the trace-time
+``_assert_train_lanes`` check: every key of ``_trace_step``'s out-dict
+literal must be enumerated or waivered, and every spec pattern must
+still match a real lane — an out lane the spec skips would silently
+lose its per-tick history the first time a train replaces the single
+ticks, and a stale pattern hides the next real gap.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import List, Optional
+
+from .engine import Finding, PackageContext, Rule
+from .rules_store import _find_module, _literal_str_tuple
+
+KERNEL_SUFFIX = "kernel/kernel.py"
+
+
+def _trace_step_out_keys(tree: ast.AST):
+    """The literal string keys of the ``out = {...}`` dict that
+    ``_trace_step`` returns, plus the dict node (or ``(None, None)``
+    when the shape is not statically reviewable)."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name == "_trace_step"):
+            continue
+        for stmt in ast.walk(node):
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "out"
+                    and isinstance(stmt.value, ast.Dict)):
+                continue
+            keys: List[str] = []
+            for k in stmt.value.keys:
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    return None, stmt.value  # computed key: unreviewable
+                keys.append(k.value)
+            return keys, stmt.value
+    return None, None
+
+
+class TrainLanesCoveredRule(Rule):
+    """Every per-tick out lane of ``_trace_step`` is enumerated by
+    TRAIN_LANE_SPEC (or waivered in TRAIN_EXCLUDED), and the spec names
+    no lane that no longer exists — a lane the train's stacked fetch
+    skips silently loses its per-tick history inside a K-tick train."""
+
+    name = "train-lanes-covered"
+    description = ("kernel/kernel.py TRAIN_LANE_SPEC (+ TRAIN_EXCLUDED) "
+                   "must enumerate every key of _trace_step's out dict, "
+                   "and match only keys that exist.")
+    per_module = False
+
+    def run_package(self, ctx: PackageContext) -> List[Finding]:
+        self.findings = []
+        kern = _find_module(ctx, KERNEL_SUFFIX)
+        if kern is None:
+            return self.findings  # contract module absent: out of scope
+        if kern.tree is None:
+            return self.findings  # parse-error finding already emitted
+
+        keys, out_node = _trace_step_out_keys(kern.tree)
+        if out_node is None:
+            self.flag(1, "_trace_step's `out = {...}` dict literal "
+                      "vanished from kernel/kernel.py — the train-lane "
+                      "coverage contract has nothing to hold onto",
+                      path=kern.rel)
+            return self.findings
+        if keys is None:
+            self.flag(out_node, "_trace_step's out dict has a computed "
+                      "key — train lanes must be literal strings to be "
+                      "reviewed statically", path=kern.rel)
+            return self.findings
+
+        spec, spec_node = _literal_str_tuple(kern.tree, "TRAIN_LANE_SPEC")
+        excl, excl_node = _literal_str_tuple(kern.tree, "TRAIN_EXCLUDED")
+        if spec_node is None:
+            self.flag(1, "TRAIN_LANE_SPEC vanished from kernel/kernel.py",
+                      path=kern.rel)
+            return self.findings
+        if spec is None:
+            self.flag(spec_node, "TRAIN_LANE_SPEC must be a literal "
+                      "tuple of strings — a computed spec cannot be "
+                      "reviewed statically", path=kern.rel)
+            return self.findings
+        if excl_node is not None and excl is None:
+            self.flag(excl_node, "TRAIN_EXCLUDED must be a literal "
+                      "tuple of strings", path=kern.rel)
+            excl = []
+        excl = excl or []
+
+        patterns = list(spec) + list(excl)
+        for key in keys:
+            if not any(fnmatch.fnmatch(key, pat) for pat in patterns):
+                self.flag(out_node, f"out lane `{key}` is not covered "
+                          "by TRAIN_LANE_SPEC or TRAIN_EXCLUDED — a "
+                          "K-tick train would silently lose its "
+                          "per-tick history", path=kern.rel)
+        for pat in patterns:
+            if not any(fnmatch.fnmatch(key, pat) for key in keys):
+                where = spec_node if pat in spec else (excl_node
+                                                      or spec_node)
+                self.flag(where, f"spec entry `{pat}` matches no "
+                          "_trace_step out lane — stale after a kernel "
+                          "refactor", path=kern.rel)
+        return self.findings

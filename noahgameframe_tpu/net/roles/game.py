@@ -178,6 +178,7 @@ class GameRole(ServerRole):
         interest_radius: Optional[float] = None,
         serve_batch: Optional[bool] = None,
         serve_overlap: Optional[bool] = None,
+        tick_train: Optional[int] = None,
         checkpoint_dir=None,
         checkpoint_seconds: float = 30.0,
         resume: bool = False,
@@ -234,6 +235,23 @@ class GameRole(ServerRole):
             _env_flag("NF_SERVE_BATCH", serve_batch)
             and interest_radius is not None
         )
+        # --- K-tick trains (ISSUE 20) --------------------------------
+        # NF_TICK_TRAIN=K (K >= 2) runs the device tick as one K-frame
+        # lax.scan megadispatch per due frame: every host-consumed lane
+        # comes back stacked [K, ...] (kernel.TRAIN_LANE_SPEC), fetched
+        # once, and fanned out in tick order — journal digest marks,
+        # death attribution and counters stay per-tick exact at 1/K the
+        # dispatch+fetch cost.  Election: trains need K >= 2 and lose
+        # to overlap mode (overlap serves each frame against the
+        # pre-tick snapshot; inside a train there is no between-frame
+        # host window), so NF_SERVE_OVERLAP=1 keeps K at 1.  The
+        # resulting staleness contract (clients see a burst of K frames
+        # per train, i.e. diffs up to K-1 ticks old) is journaled like
+        # the overlap contract so replay honors the same engine.
+        k_train = (int(tick_train) if tick_train is not None
+                   else _env_int("NF_TICK_TRAIN", 0))
+        self.tick_train = k_train if (k_train >= 2
+                                      and not self.serve_overlap) else 0
         from ..serving import SessionTable
 
         self._session_table = SessionTable()
@@ -253,6 +271,8 @@ class GameRole(ServerRole):
             WorldConfig(combat=False, movement=False, regen=True)
         ).start()
         self.kernel = self.game_world.kernel
+        if self.tick_train:
+            self.kernel.configure_train(self.tick_train)
         self.scene = self.game_world.scene
         self.scene_id = scene_id
         self.sync_classes = tuple(sync_classes)
@@ -436,7 +456,16 @@ class GameRole(ServerRole):
                     # must honor the same engine to stay digest-clean
                     "serve_batch": bool(self.serve_batch),
                     "serve_overlap": bool(self.serve_overlap),
-                    "serve_staleness_ticks": 1 if self.serve_overlap else 0,
+                    # trains deliver diffs/events in a burst after each
+                    # K-tick megadispatch: staleness <= K-1 ticks.  The
+                    # per-tick marks are stamped from in-lane tick
+                    # numbers, so replay (one real tick per mark) is
+                    # bit-identical with the knob flipped either way.
+                    "tick_train": int(self.tick_train),
+                    "serve_staleness_ticks": (
+                        self.tick_train - 1 if self.tick_train
+                        else (1 if self.serve_overlap else 0)
+                    ),
                 },
             )
             # tap BOTH dispatch choke points: client/proxy traffic on the
@@ -531,6 +560,20 @@ class GameRole(ServerRole):
         self._serve_sessions_hist = sreg.histogram(
             "nf_serve_sessions",
             "sessions covered by one batched serve dispatch",
+        )
+        # K-tick train accounting (mirrors kernel.train_* — counted
+        # here so bare-kernel benches still track their own ints)
+        self._train_dispatches = sreg.counter(
+            "nf_train_dispatches_total",
+            "K-tick train megadispatches (one scan program per count)",
+        )
+        self._train_ticks = sreg.counter(
+            "nf_train_ticks_total",
+            "logical ticks advanced inside train dispatches",
+        )
+        self._train_fetch_bytes = sreg.counter(
+            "nf_train_fetch_bytes_total",
+            "stacked [K, ...] summary-lane bytes fetched per train",
         )
         self._stage_timing = stage_timing_enabled()
         self.kernel.stage_timing = self._stage_timing
@@ -1848,8 +1891,10 @@ class GameRole(ServerRole):
                 cn for cn in self.sync_classes if cn in self._serve_pending
             ]
             self._serve_pending.clear()
+        train_outs = None
         if tick_due:
             self._last_tick = now
+            ticks_this_frame = self.tick_train or 1
             with self.telemetry.tracer.span("game.tick"), sc.stage("tick"):
                 t0 = _time.perf_counter()
                 for m in pm.modules.values():
@@ -1874,10 +1919,30 @@ class GameRole(ServerRole):
                         for d in pend:
                             self._serve_pos_emit(d)
                     self.kernel.tick_finish(raw)
+                elif self.tick_train:
+                    # one K-frame megadispatch; per-frame host effects
+                    # (events, diffs, tick-exact deaths, counters) fan
+                    # out in order from the stacked lanes
+                    d0, t0k, b0 = (self.kernel.train_dispatches,
+                                   self.kernel.train_ticks,
+                                   self.kernel.train_fetch_bytes)
+                    train_outs = self.kernel.train(self.tick_train)
+                    self._train_dispatches.inc(
+                        self.kernel.train_dispatches - d0)
+                    self._train_ticks.inc(self.kernel.train_ticks - t0k)
+                    self._train_fetch_bytes.inc(
+                        self.kernel.train_fetch_bytes - b0)
                 else:
                     self.kernel.tick()
-                pm.frame += 1
-                self._tick_hist.observe(_time.perf_counter() - t0)
+                pm.frame += ticks_this_frame
+                # per-tick latency even under trains: one train frame is
+                # K ticks of device work behind one dispatch
+                self._tick_hist.observe(
+                    (_time.perf_counter() - t0) / ticks_this_frame)
+            if ticks_this_frame > 1:
+                # nf_stage_tick_seconds stays a per-tick distribution
+                # across NF_TICK_TRAIN settings (waterfall stays exact)
+                sc.set_scale("tick", ticks_this_frame)
             if self.elastic is not None:
                 # advance any in-flight grow/drain; when one completes,
                 # force-reset exactly the sessions whose seen-state
@@ -1888,11 +1953,21 @@ class GameRole(ServerRole):
                     self._reset_views_for_moved(moved)
             if self.journal is not None:
                 # closes this tick's input window; the digest rode the
-                # summary fetch the tick already paid for
-                self.journal.tick_mark(
-                    self.kernel.tick_count,
-                    self.kernel.last_counters.get("state_digest", 0),
-                )
+                # summary fetch the tick already paid for.  A train
+                # writes one mark PER stacked frame from the in-lane
+                # tick/digest stamps — replay runs one real tick per
+                # mark and must compare like for like.
+                if train_outs is not None:
+                    for o in train_outs:
+                        self.journal.tick_mark(
+                            o.counters.get("tick", self.kernel.tick_count),
+                            o.counters.get("state_digest", 0),
+                        )
+                else:
+                    self.journal.tick_mark(
+                        self.kernel.tick_count,
+                        self.kernel.last_counters.get("state_digest", 0),
+                    )
                 self._journal_pump_counters()
             if self.persist is not None:
                 # stage this tick's dirty set; all store I/O stays on

@@ -424,6 +424,14 @@ class RoomBatch:
         self._seed = int(seed)
         self._jit_step = None
         self._jit_run = None
+        self._jit_train = None
+        self._train_k = 0
+        # train accounting (mirrors Kernel.train_*): dispatches land on
+        # the batch, not the template — the template's own entries are
+        # unused under a RoomBatch
+        self.train_dispatches = 0
+        self.train_ticks = 0
+        self.train_fetch_bytes = 0
         self._jit_admit = None
         self._jit_extract = None
         self._seen_trace_gen = getattr(template, "_trace_gen", 0)
@@ -466,7 +474,7 @@ class RoomBatch:
         if gen == self._seen_trace_gen:
             return
         self._seen_trace_gen = gen
-        self._jit_step = self._jit_run = None
+        self._jit_step = self._jit_run = self._jit_train = None
         self._jit_admit = self._jit_extract = None
         self._blank = self._blank_room()
         for cname in self.kernel.store.class_order:
@@ -528,27 +536,123 @@ class RoomBatch:
         self.last_counters = self.kernel.decode_counters(np.asarray(summary))
         return self.last_counters
 
-    def run(self, n: int) -> None:
-        """n frames for every room, zero host syncs (fori_loop over the
-        vmapped step, traced trip count — one compile serves every n)."""
+    def run(self, n: int) -> Dict[str, np.ndarray]:
+        """n frames for every room, zero host syncs inside (fori_loop
+        over the vmapped step, traced trip count — one compile serves
+        every n).  The final frame's summary rides the carry out, so
+        ``last_counters`` reflects the post-run world instead of going
+        stale at the pre-run tick (the r12 bug: a drill sampling
+        counters after run() read frame N-n's numbers as frame N's)."""
         self._sync_generation()
+        if int(n) <= 0:
+            return self.last_counters
         if self._jit_run is None:
             k = self.kernel
 
-            def body(_, st):
-                st2, _out = jax.vmap(k._trace_step)(st)
-                return st2
+            def body(_, carry):
+                st, _prev = carry
+                st2, out = jax.vmap(k._trace_step)(st)
+                return st2, out["summary"]
+
+            def runner(st, t):
+                st1, out = jax.vmap(k._trace_step)(st)
+                return jax.lax.fori_loop(0, t - 1, body, (st1, out["summary"]))
 
             jkw = {}
             if self.mesh is not None:
                 sh = self.shardings()
-                jkw = {"in_shardings": (sh, None), "out_shardings": sh}
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                jkw = {"in_shardings": (sh, None),
+                       "out_shardings": (sh, NamedSharding(
+                           self.mesh, PartitionSpec(ROOMS_AXIS)))}
             self._jit_run = self.costbook.wrap(
-                "rooms.run",
-                lambda st, t: jax.lax.fori_loop(0, t, body, st),
+                "rooms.run", runner,
                 donate_argnums=0, stage="tick", jit_kwargs=jkw)
-        self.state = self._jit_run(self.state, jnp.int32(int(n)))
+        self.state, summary = self._jit_run(self.state, jnp.int32(int(n)))
         self.tick_count += int(n)
+        self.last_counters = self.kernel.decode_counters(np.asarray(summary))
+        return self.last_counters
+
+    # ---------------------------------------------------------- trains
+    def configure_train(self, k: int) -> None:
+        """Pin the train length (see Kernel.configure_train); the
+        template's K is synced so its scan trace matches."""
+        self.kernel.configure_train(k)
+        if int(k) != self._train_k:
+            self._train_k = int(k)
+            self._jit_train = None
+
+    def _compile_train(self):
+        if self._jit_train is not None:
+            return self._jit_train
+        if self._train_k < 1:
+            raise RuntimeError("configure_train(k) before train()")
+        k = self.kernel
+        kk = self._train_k
+
+        def vtrain(st):
+            # vmap INSIDE the scan: each scanned step advances all R
+            # rooms, so the stacked summary comes out [K, R, L] with
+            # the room axis sharding preserved on axis 1.  Only the
+            # summary lane survives to the host — the rooms engine's
+            # whole per-tick observed surface IS the counter bank
+            # (rooms.step makes the same reduction), so fired/diff/
+            # event lanes are DCE'd, not lost.
+            def body(s, _):
+                s2, out = jax.vmap(k._trace_step)(s)
+                return s2, out["summary"]
+
+            return jax.lax.scan(body, st, None, length=kk)
+
+        jkw = {}
+        if self.mesh is not None:
+            sh = self.shardings()
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            jkw = {"in_shardings": (sh,),
+                   "out_shardings": (sh, NamedSharding(
+                       self.mesh, PartitionSpec(None, ROOMS_AXIS)))}
+        self._jit_train = self.costbook.wrap(
+            "rooms.train", vtrain, donate_argnums=0, stage="tick",
+            jit_kwargs=jkw)
+        return self._jit_train
+
+    def train(self, n: int) -> np.ndarray:
+        """n frames for every room in ⌊n/K⌋ megadispatches plus a
+        per-tick ragged tail; per-tick per-room counters survive as
+        stacked ``[K, R, L]`` summary lanes fetched ONCE per train.
+
+        Returns the concatenated ``[n, R, L]`` summary (one row per
+        logical tick, in order — decode with ``kernel.decode_counters``
+        for per-tick ``[R]`` counter columns, including the in-lane
+        "tick" stamp and, when enabled, "state_digest").
+        ``last_counters`` lands on the final frame."""
+        self._sync_generation()
+        n = int(n)
+        kk = self._train_k
+        if kk < 1:
+            raise RuntimeError("configure_train(k) before train()")
+        jt = self._compile_train()
+        lanes: List[np.ndarray] = []
+        for _ in range(n // kk):
+            self.state, stacked = jt(self.state)
+            self.tick_count += kk
+            self.train_dispatches += 1
+            self.train_ticks += kk
+            arr = np.asarray(stacked)  # ONE [K, R, L] fetch per train
+            self.train_fetch_bytes += arr.nbytes
+            lanes.append(arr)
+        for _ in range(n % kk):
+            step = self._compile_step()
+            self.state, summary = step(self.state)
+            self.tick_count += 1
+            lanes.append(np.asarray(summary)[None])
+        out = (np.concatenate(lanes, axis=0) if lanes
+               else np.zeros((0, self.capacity, 0), np.int32))
+        if len(out):
+            self.last_counters = self.kernel.decode_counters(out[-1])
+        return out
 
     # ---------------------------------------------------- slot plumbing
     def _room_payload(self, room: WorldState) -> WorldState:

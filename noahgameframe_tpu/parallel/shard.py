@@ -88,6 +88,8 @@ class ShardedKernel:
         self._jit_step = None
         self._jit_step1 = None
         self._jit_run = None
+        self._jit_train = None
+        self._train_k = 0
         self._shardings = None
         self._shardings_key = None
         self._seen_trace_gen = getattr(kernel, "_trace_gen", 0)
@@ -139,6 +141,7 @@ class ShardedKernel:
             self._jit_step = None
             self._jit_step1 = None
             self._jit_run = None
+            self._jit_train = None
             self._shardings = None
             self._seen_trace_gen = gen
 
@@ -192,6 +195,7 @@ class ShardedKernel:
         self._jit_step = None
         self._jit_step1 = None
         self._jit_run = None
+        self._jit_train = None
         self._shardings = None
         self._seen_trace_gen = getattr(k, "_trace_gen", 0)
         k._ensure_aux()
@@ -239,7 +243,20 @@ class ShardedKernel:
                 )
             ],
         )
-        k._post_tick(out, np.asarray(raw["summary"]))
+        summary = np.asarray(raw["summary"])
+        # decode the counter bank exactly like Kernel.tick_finish — a
+        # sharded frame's observers (journal digest marks, train tails)
+        # read the same surface as a single-device frame's
+        if k._counter_names:
+            out.counters = {
+                kk: int(v) for kk, v in k.decode_counters(summary).items()
+            }
+            k.last_counters = dict(out.counters)
+            for kk, v in out.counters.items():
+                if kk in ("state_digest", "tick"):
+                    continue
+                k.counter_totals[kk] = k.counter_totals.get(kk, 0) + v
+        k._post_tick(out, summary)
         return out
 
     def _compile_headless(self):
@@ -298,6 +315,54 @@ class ShardedKernel:
             )
         self.kernel.state = self._jit_run(self.kernel.state, jnp.int32(key))
         self.kernel.tick_count += key
+
+    # -- K-tick trains --------------------------------------------------------
+
+    def configure_train(self, k: int) -> None:
+        """Pin the sharded train length (see Kernel.configure_train).
+        The wrapped kernel's K is kept in sync so its lane fan-out
+        (train_finish) slices the right depth."""
+        self.kernel.configure_train(k)
+        if int(k) != self._train_k:
+            self._train_k = int(k)
+            self._jit_train = None
+
+    def _compile_train(self):
+        if self._jit_train is None:
+            if self._train_k < 1:
+                raise RuntimeError("configure_train(k) before train()")
+            shardings = self.shardings()
+            self._jit_train = self.kernel.costbook.wrap(
+                "kernel.sharded_train", self.kernel._trace_train,
+                donate_argnums=0, stage="tick",
+                jit_kwargs={"in_shardings": (shardings,),
+                            "out_shardings": (shardings, None)},
+            )
+        return self._jit_train
+
+    def train(self, n: int):
+        """n sharded frames in ⌊n/K⌋ train dispatches + a per-tick
+        ragged tail, with full host observation per frame — shardings
+        carried through the scan, lanes fanned out by the wrapped
+        kernel's train_finish (tick-exact death attribution included)."""
+        n = int(n)
+        k = self.kernel
+        kk = self._train_k
+        if kk < 1:
+            raise RuntimeError("configure_train(k) before train()")
+        self._sync_generation()
+        k._ensure_aux()
+        jt = self._compile_train()
+        outs = []
+        for _ in range(n // kk):
+            k.state, raw = jt(k.state)
+            k.tick_count += kk
+            k.train_dispatches += 1
+            k.train_ticks += kk
+            outs.extend(k.train_finish(raw))
+        for _ in range(n % kk):
+            outs.append(self.tick())
+        return outs
 
 
 def shard_rows_by_cell(n: int, n_devices: int, cell: np.ndarray) -> np.ndarray:

@@ -144,6 +144,13 @@ class StageClock:
     def __init__(self, registry=None, window: int = 512):
         self._acc: Dict[str, int] = {}
         self._stack: List[_StageCtx] = []
+        # per-frame histogram divisors (stage -> int), cleared by
+        # frame_begin: a K-tick train charges K frames of device work
+        # to ONE "tick" stage span, so the banked histogram sample is
+        # divided by K to stay per-tick comparable across NF_TICK_TRAIN
+        # settings.  ONLY the histogram observation scales — the
+        # waterfall (`last`, `other`, wall) stays exact.
+        self._scale: Dict[str, int] = {}
         self._frame_t0 = 0
         self.last: Dict[str, int] = {}
         self.last_tick = -1
@@ -168,9 +175,16 @@ class StageClock:
         if self._stack:
             self._stack[-1]._child_ns += ns
 
+    def set_scale(self, name: str, k: int) -> None:
+        """Amortize this frame's ``name`` stage over ``k`` logical ticks
+        when banking its histogram (``nf_stage_<name>_seconds`` stays a
+        PER-TICK distribution under K-tick trains).  Resets each frame."""
+        self._scale[name] = max(1, int(k))
+
     def frame_begin(self, tick: int) -> None:
         self._acc = {}
         self._stack = []
+        self._scale = {}
         self.last_tick = int(tick)
         self._frame_t0 = time.perf_counter_ns()
 
@@ -185,7 +199,7 @@ class StageClock:
         for name, ns in acc.items():
             h = self._hists.get(name)
             if h is not None:
-                h.observe(ns / 1e9)
+                h.observe(ns / 1e9 / self._scale.get(name, 1))
         return self.last
 
     def stats(self) -> Dict[str, Dict[str, float]]:

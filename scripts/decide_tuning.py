@@ -115,6 +115,24 @@ def main() -> None:
     if count_ms is not None and count_ms < count_base * MARGIN:
         tuning["NF_BINNING"] = "count"
 
+    # K-tick trains (NF_TICK_TRAIN, ISSUE 20): the r13 A/B captures the
+    # 100k tick with --train 8 (tick_ms is already amortized PER TICK:
+    # train wall / K), compared against the same-shape 100k baseline —
+    # the 1M `base` above is the wrong shape for this election.  Trains
+    # only pay off where the per-dispatch host round-trip is a real
+    # fraction of the tick, so the promotion is measured, never assumed.
+    # Crash-immune like every rule here: a missing/errored capture is
+    # None and doesn't compete.
+    train_base = tick_ms("r07_tpu_100k.json")
+    if train_base is None:
+        train_base = tick_ms("r05_tpu_100k_v2.json")
+    train_ms = tick_ms("r13_tpu_100k_train8.json")
+    detail["train_base_100k_tick_ms"] = train_base
+    detail["train8_100k_tick_ms"] = train_ms
+    if (train_base is not None and train_ms is not None
+            and train_ms < train_base * MARGIN):
+        tuning["NF_TICK_TRAIN"] = "8"
+
     out = {"env": tuning, "detail": detail}
     with open(os.path.join(RUNS, "tuning.json"), "w") as f:
         json.dump(out, f, indent=1)

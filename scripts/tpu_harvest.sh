@@ -217,8 +217,24 @@ while :; do
     echo "[$(date -u +%H:%M:%S)] SKIP rooms — no devices exposed"
   fi
 
+  # 12. K-tick trains on chip (ISSUE 20 r13): the 100k tick under an
+  #     8-tick lax.scan megadispatch (tick_ms amortized per tick), vs
+  #     the item-0 baseline decide_tuning already compares against —
+  #     NF_TICK_TRAIN=8 is promoted only on a measured >3% win.  The
+  #     rooms arm re-runs the many-worlds ladder with trains so the
+  #     flagship 256-room point gets its train number on real chips.
+  run_item b100k_train8 900 python -u bench.py --entities 100000 --ticks 96 --train 8 --platform tpu \
+    && save_json b100k_train8 bench_runs/r13_tpu_100k_train8.json
+  if [ "$NDEV" -ge 1 ]; then
+    run_item rooms_train8 1800 python -u bench.py --rooms "$NDEV" --platform tpu \
+        --rooms-count 64,256,1024 --rooms-entities 64 --train 8 \
+      && save_json rooms_train8 bench_runs/r13_rooms_tpu_train8.json
+  else
+    echo "[$(date -u +%H:%M:%S)] SKIP rooms_train8 — no devices exposed"
+  fi
+
   n_done=$(ls "$STAMPS" | wc -l)
-  if [ "$n_done" -ge 25 ]; then
+  if [ "$n_done" -ge 27 ]; then
     echo "[$(date -u +%H:%M:%S)] queue drained — exiting"
     exit 0
   fi

@@ -1,0 +1,26 @@
+"""Bad fixture for train-lanes-covered: _trace_step grew an `aggro`
+out lane the train spec never learned about, and the spec still names
+a `casts` lane that a kernel refactor deleted."""
+
+TRAIN_LANE_SPEC = (
+    "fired",
+    "diff",
+    "died",
+    "casts",  # <- stale: no such out lane anymore
+    "summary",
+)
+
+TRAIN_EXCLUDED = ()
+
+
+class Kernel:
+    def _trace_step(self, state):
+        fired = diff = died = aggro = summary = state
+        out = {
+            "fired": fired,
+            "diff": diff,
+            "died": died,
+            "aggro": aggro,  # <- unlisted: train would drop its history
+            "summary": summary,
+        }
+        return state, out

@@ -1,0 +1,25 @@
+"""Good fixture for train-lanes-covered: every _trace_step out lane is
+enumerated by the train spec or waivered with a reason, nothing stale."""
+
+TRAIN_LANE_SPEC = (
+    "fired",
+    "diff",
+    "died",
+    "summary",
+)
+
+# scratch.* lanes are trace-debug only, never consumed by host code
+TRAIN_EXCLUDED = ("scratch.debug",)
+
+
+class Kernel:
+    def _trace_step(self, state):
+        fired = diff = died = summary = scratch = state
+        out = {
+            "fired": fired,
+            "diff": diff,
+            "died": died,
+            "scratch.debug": scratch,
+            "summary": summary,
+        }
+        return state, out

@@ -185,6 +185,56 @@ def test_binning_error_capture_not_elected(tmp_path, capsys):
     assert "NF_BINNING" not in got["env"]
 
 
+def test_train8_elected_when_it_beats_100k_margin(tmp_path, capsys):
+    """The r13 A/B: NF_TICK_TRAIN=8 wins against the same-shape 100k
+    baseline (never the 1M one — wrong shape for the election)."""
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r07_tpu_100k.json", 20.0)
+    _w(tmp_path, "r13_tpu_100k_train8.json", 15.0)  # beats 20 * 0.97
+    got = _run(mod, capsys)
+    assert got["env"] == {"NF_TICK_TRAIN": "8"}
+    assert got["detail"]["train_base_100k_tick_ms"] == 20.0
+    assert got["detail"]["train8_100k_tick_ms"] == 15.0
+
+
+def test_train8_within_margin_keeps_single_ticks(tmp_path, capsys):
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r07_tpu_100k.json", 20.0)
+    _w(tmp_path, "r13_tpu_100k_train8.json", 19.6)  # within 3%: tie -> off
+    got = _run(mod, capsys)
+    assert "NF_TICK_TRAIN" not in got["env"]
+
+
+def test_train8_needs_a_100k_baseline(tmp_path, capsys):
+    """No 100k capture at all: the train election does NOT fall back to
+    the 1M baseline — a cross-shape 'win' would be phantom."""
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r13_tpu_100k_train8.json", 5.0)
+    got = _run(mod, capsys)
+    assert "NF_TICK_TRAIN" not in got["env"]
+
+
+def test_train8_falls_back_to_v2_baseline(tmp_path, capsys):
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r05_tpu_100k_v2.json", 20.0)
+    _w(tmp_path, "r13_tpu_100k_train8.json", 15.0)
+    got = _run(mod, capsys)
+    assert got["env"] == {"NF_TICK_TRAIN": "8"}
+
+
+def test_train8_error_capture_not_elected(tmp_path, capsys):
+    mod = _load(tmp_path)
+    _w(tmp_path, "r05_tpu_1m.json", 100.0)
+    _w(tmp_path, "r07_tpu_100k.json", 20.0)
+    _w(tmp_path, "r13_tpu_100k_train8.json", 1.0, error="tunnel died")
+    got = _run(mod, capsys)
+    assert "NF_TICK_TRAIN" not in got["env"]
+
+
 def test_bench_applies_tuning_env(tmp_path, monkeypatch):
     """bench.py's loader: setdefault semantics (explicit env wins)."""
     runs = tmp_path / "bench_runs"
